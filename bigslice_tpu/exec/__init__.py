@@ -1,0 +1,17 @@
+from bigslice_tpu.exec.task import Task, TaskDep, TaskName, TaskState, TaskError
+from bigslice_tpu.exec.compile import compile_slice
+from bigslice_tpu.exec.evaluate import evaluate
+from bigslice_tpu.exec.session import Session, Result, start
+
+__all__ = [
+    "Task",
+    "TaskDep",
+    "TaskName",
+    "TaskState",
+    "TaskError",
+    "compile_slice",
+    "evaluate",
+    "Session",
+    "Result",
+    "start",
+]

@@ -1,0 +1,252 @@
+"""The planner: slice DAG → task graph with pipeline fusion.
+
+Mirrors exec/compile.go:111-387:
+
+- *Pipelining*: chains of slices without shuffle dependencies fuse into a
+  single task per shard (``pipeline``, exec/compile.go:29-48). On TPU this
+  is doubly meaningful: a fused chain of traceable ops executes as jitted
+  stages over the same resident batches, letting XLA fuse elementwise work
+  into one program.
+- *Memoization*: compilation is memoized per (slice, numPartition)
+  (exec/compile.go:195-215), so diamond-shaped DAGs share tasks.
+- *Result reuse*: slices that are ``Result``s of prior session runs reuse
+  their already-computed tasks; shuffle consumers get ``_shuffle`` adapter
+  tasks inserted (exec/compile.go:226-261).
+- *Combiner plumbing*: a consumer's combiner is wired into its *producer*
+  tasks' partitioners for map-side combining (exec/compile.go:300-334).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from bigslice_tpu.ops.base import Slice, unwrap
+from bigslice_tpu.exec.task import Partitioner, Task, TaskDep, TaskName
+from bigslice_tpu import sliceio
+
+
+def pipeline(slice_: Slice) -> List[Slice]:
+    """The fusable chain starting at slice_ (outermost first), mirroring
+    exec/compile.go:29-48."""
+    out: List[Slice] = []
+    while True:
+        # Stop at Results so prior tasks can be reused.
+        if _is_result(unwrap(slice_)):
+            return out
+        out.append(slice_)
+        deps = slice_.deps()
+        if len(deps) != 1:
+            return out
+        dep = deps[0]
+        if dep.shuffle:
+            return out
+        if dep.slice.materialize:
+            return out
+        slice_ = dep.slice
+
+
+def _is_result(slice_: Slice) -> bool:
+    from bigslice_tpu.exec.session import Result
+
+    return isinstance(slice_, Result)
+
+
+class Compiler:
+    def __init__(self, inv_index: int):
+        self.inv_index = inv_index
+        self._memo: Dict[Tuple[int, int], List[Task]] = {}
+
+    def compile(self, slice_: Slice,
+                part: Optional[Partitioner] = None) -> List[Task]:
+        """Compile ``slice_`` into one task per shard whose outputs are
+        partitioned per ``part``."""
+        if part is None:
+            part = Partitioner(num_partition=1)
+        # The memo key must capture the full output-partitioning config:
+        # two consumers with equal partition counts but different
+        # partitioners/combiners (e.g. Reduce(s) and Reshuffle(s)) must NOT
+        # share producer tasks, or one would silently receive the other's
+        # pre-combined/re-routed output. Combiners key on the user combine
+        # fn so that identical reduces still share.
+        comb = part.combiner
+        key = (
+            id(slice_),
+            part.num_partition,
+            part.combine_key,
+            id(part.partition_fn) if part.partition_fn is not None else None,
+            id(comb.fn) if comb is not None else None,
+        )
+        if key in self._memo:
+            return self._memo[key]
+
+        un = unwrap(slice_)
+        if _is_result(un):
+            tasks = self._compile_result(un, slice_, part)
+            self._memo[key] = tasks
+            return tasks
+
+        chain = pipeline(slice_)
+        if not chain:
+            # slice_ itself unwraps to a Result.
+            tasks = self._compile_result(un, slice_, part)
+            self._memo[key] = tasks
+            return tasks
+        innermost = chain[-1]
+        num_tasks = slice_.num_shards
+
+        # Compile dependencies. A shuffle dep's producer tasks partition
+        # their output into num_tasks partitions and take the consumer's
+        # combiner (map-side combining).
+        dep_task_lists: List[Tuple[List[Task], bool]] = []
+        for dep in innermost.deps():
+            if dep.shuffle:
+                dep_part = Partitioner(
+                    num_partition=num_tasks,
+                    partition_fn=dep.partitioner,
+                    combiner=_frame_combiner(innermost),
+                )
+            else:
+                # Non-shuffle boundary (materialized dep or multi-dep):
+                # the dep must have the same shard structure; partition 0
+                # carries everything.
+                dep_part = Partitioner(num_partition=1)
+            dep_tasks = self.compile(dep.slice, dep_part)
+            dep_task_lists.append((dep_tasks, dep))
+
+        op_name = "_".join(s.name.op for s in reversed(chain))
+        loc = chain[0].name
+        if loc.file:
+            import os
+
+            op_name = f"{op_name}@{os.path.basename(loc.file)}:{loc.line}"
+        if loc.index:
+            op_name = f"{op_name}#{loc.index}"
+
+        slice_names = [str(s.name) for s in chain]
+        tasks: List[Task] = []
+        for shard in range(num_tasks):
+            deps = []
+            for dep_tasks, dep in dep_task_lists:
+                if dep.shuffle:
+                    deps.append(
+                        TaskDep(tuple(dep_tasks), shard, expand=dep.expand)
+                    )
+                else:
+                    # Aligned read: shard i reads dep shard i's partition 0.
+                    deps.append(TaskDep((dep_tasks[shard],), 0))
+            name = TaskName(self.inv_index, op_name, shard, num_tasks)
+            tasks.append(
+                Task(
+                    name=name,
+                    do=_make_do(chain, shard),
+                    deps=deps,
+                    partitioner=part,
+                    schema=slice_.schema,
+                    procs=slice_.procs,
+                    exclusive=slice_.exclusive,
+                    slice_names=slice_names,
+                )
+            )
+        self._memo[key] = tasks
+        return tasks
+
+    def _compile_result(self, result, slice_: Slice,
+                        part: Partitioner) -> List[Task]:
+        """Reuse a prior run's tasks; insert `_shuffle` adapter tasks when
+        the consumer needs different partitioning (exec/compile.go:226-261)."""
+        prior = list(result.tasks)
+        if part.num_partition == 1 and part.combiner is None:
+            return prior
+        adapters = []
+        for shard, ptask in enumerate(prior):
+            name = TaskName(
+                self.inv_index,
+                f"{ptask.name.op}_shuffle",
+                shard,
+                len(prior),
+            )
+            adapters.append(
+                Task(
+                    name=name,
+                    do=_identity_do(),
+                    deps=[TaskDep((ptask,), 0)],
+                    partitioner=part,
+                    schema=slice_.schema,
+                    slice_names=(str(slice_.name),),
+                )
+            )
+        return adapters
+
+
+def _frame_combiner(consumer: Slice):
+    comb = consumer.combiner()
+    if comb is None:
+        return None
+    # Reduce carries a prebuilt FrameCombiner; otherwise build one from the
+    # combiner function over the dep's schema.
+    fc = getattr(consumer, "frame_combiner", None)
+    if fc is not None:
+        return fc
+    from bigslice_tpu.ops.reduce import FrameCombiner
+
+    return FrameCombiner(comb.fn, consumer.deps()[0].slice.schema)
+
+
+def _make_do(chain: Sequence[Slice], shard: int):
+    """Compose the chain's readers into one task body
+    (exec/compile.go:338-385). Re-entrant: each call builds fresh
+    readers, so lost-task reruns are safe."""
+
+    def do(dep_factories):
+        reader = chain[-1].reader(shard, dep_factories)
+        for s in reversed(chain[:-1]):
+            r_prev = reader
+            reader = s.reader(shard, [lambda r=r_prev: r])
+        return reader
+
+    return do
+
+
+def _identity_do():
+    def do(dep_factories):
+        return dep_factories[0]()
+
+    return do
+
+
+def compile_slice(slice_: Slice, inv_index: int = 1) -> List[Task]:
+    """Compile an invocation's slice into root tasks (one per shard),
+    outputs unpartitioned (read back by Result scanning)."""
+    return Compiler(inv_index).compile(slice_, Partitioner(num_partition=1))
+
+
+def graph_string(roots: Sequence[Task], locations: bool = True) -> str:
+    """Deterministic text rendering of a task graph, for golden tests
+    (mirrors exec/testdata/*.graph). ``locations=False`` strips
+    file:line/index qualifiers so goldens don't depend on test-file line
+    numbers."""
+    import re
+
+    from bigslice_tpu.exec.task import iter_tasks
+
+    def clean(s: str) -> str:
+        if locations:
+            return s
+        # Strip "@file.ext:line(#idx)" but keep the "@num_shard:shard"
+        # task suffix (which has no dot).
+        return re.sub(r"@[\w\-]+\.[\w\-]+:\d+(#\d+)?", "", s)
+
+    lines = []
+    for t in iter_tasks(roots):
+        deps = []
+        for d in t.deps:
+            names = ",".join(clean(str(x.name)) for x in d.tasks)
+            mark = "~" if d.expand else ""
+            deps.append(f"[p{d.partition}{mark} <- {names}]")
+        part = ""
+        if t.num_partition > 1:
+            part = f" part={t.num_partition}"
+            if t.combiner is not None:
+                part += "+combine"
+        lines.append(f"{clean(str(t.name))}{part} deps={' '.join(deps) or '-'}")
+    return "\n".join(lines) + "\n"

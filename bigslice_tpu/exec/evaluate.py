@@ -1,0 +1,134 @@
+"""The evaluator: concurrency-safe task DAG state machine.
+
+Mirrors exec/eval.go:80-176: given root tasks and an executor, drive every
+reachable task to OK —
+
+- tasks become runnable when all their dependencies are OK;
+- LOST tasks (machine failure, missing shuffle output) are resubmitted,
+  re-running their (possibly transitive) producers;
+- ``MAX_CONSECUTIVE_LOST`` consecutive losses turn a task fatal
+  (exec/eval.go:30);
+- multiple concurrent evaluations of overlapping graphs coordinate purely
+  through task state (exec/eval.go:126-135) — an eval that sees a task
+  RUNNING simply waits for its transition.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Sequence
+
+from bigslice_tpu.exec.task import (
+    Task,
+    TaskError,
+    TaskState,
+    iter_tasks,
+)
+
+MAX_CONSECUTIVE_LOST = 5  # exec/eval.go:30
+
+
+def evaluate(executor, roots: Sequence[Task], monitor=None) -> None:
+    """Evaluate the graph rooted at ``roots`` to completion.
+
+    ``executor`` implements ``submit(task)`` (async: eventually moves the
+    task from WAITING to a terminal state). ``monitor``, if given, receives
+    ``(task, state)`` transition callbacks (status displays, tracing).
+    """
+    tasks = iter_tasks(roots)
+    cond = threading.Condition()
+
+    def wake(task: Task, state: TaskState) -> None:
+        if monitor is not None:
+            monitor(task, state)
+        with cond:
+            cond.notify_all()
+
+    for t in tasks:
+        t.subscribe(wake)
+    try:
+        _loop(executor, roots, tasks, cond)
+    finally:
+        for t in tasks:
+            t.unsubscribe(wake)
+
+
+def _loop(executor, roots, tasks, cond) -> None:
+    while True:
+        # Terminal checks.
+        states = {id(t): t.state for t in tasks}
+        if any(states[id(t)] == TaskState.ERR for t in tasks):
+            # Let in-flight tasks settle, then surface the first error.
+            bad = next(t for t in tasks if t.state == TaskState.ERR)
+            _drain(tasks, cond)
+            raise TaskError(bad, bad.error or RuntimeError("task error"))
+        if all(states[id(r)] == TaskState.OK for r in roots):
+            return
+
+        progressed = False
+        for t in tasks:
+            st = t.state
+            if st not in (TaskState.INIT, TaskState.LOST):
+                continue
+            # A task whose result has been lost must wait for its deps to
+            # be re-evaluated; deps appear earlier in post-order, so
+            # they're submitted in this same pass.
+            if not all(
+                d.state == TaskState.OK for d in t.all_dep_tasks()
+            ):
+                continue
+            if t.consecutive_lost >= MAX_CONSECUTIVE_LOST:
+                t.set_state(
+                    TaskState.ERR,
+                    RuntimeError(
+                        f"task {t.name} lost {t.consecutive_lost} "
+                        f"consecutive times"
+                    ),
+                )
+                progressed = True
+                break
+            if t.transition_if(st, TaskState.WAITING):
+                executor.submit(t)
+                progressed = True
+        if progressed:
+            continue
+        # Nothing to submit: either work is in flight, or we're waiting on
+        # another evaluation driving shared tasks.
+        in_flight = any(
+            t.state in (TaskState.WAITING, TaskState.RUNNING) for t in tasks
+        )
+        with cond:
+            if in_flight or _dirty(tasks, roots):
+                cond.wait(timeout=0.2)
+            else:
+                # No running tasks, roots not OK, nothing runnable: a
+                # cycle or an executor that dropped a task. Should be
+                # impossible; fail loudly rather than hang.
+                if all(t.state == TaskState.OK for t in roots):
+                    return
+                raise RuntimeError(
+                    "evaluation stalled: no runnable or running tasks"
+                )
+
+
+def _dirty(tasks, roots) -> bool:
+    """Re-check for actionable state that raced with our scan."""
+    if all(r.state == TaskState.OK for r in roots):
+        return True
+    for t in tasks:
+        if t.state in (TaskState.INIT, TaskState.LOST, TaskState.ERR):
+            return True
+    return False
+
+
+def _drain(tasks, cond, timeout: float = 30.0) -> None:
+    import time
+
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if not any(
+            t.state in (TaskState.WAITING, TaskState.RUNNING) for t in tasks
+        ):
+            return
+        with cond:
+            cond.wait(timeout=0.2)

@@ -1,0 +1,127 @@
+"""Sessions: compile + evaluate + result scanning.
+
+Mirrors exec/session.go: a Session owns an executor, compiles Func
+invocations into task graphs (memoizing per invocation), evaluates them,
+and returns ``Result``s — which are themselves Slices, so results feed
+later invocations without recomputation (the iterative-workload mechanism,
+exec/session.go:391-442 + exec/compile.go:226-261).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from bigslice_tpu import typecheck
+from bigslice_tpu.ops.base import Slice, make_name
+from bigslice_tpu.ops.func import Func, Invocation
+from bigslice_tpu import sliceio
+from bigslice_tpu.exec import compile as compile_mod
+from bigslice_tpu.exec.evaluate import evaluate
+from bigslice_tpu.exec.task import Task, TaskState
+from bigslice_tpu.utils import metrics as metrics_mod
+
+
+class Result(Slice):
+    """A computed slice: the output of a session run (exec/session.go:391).
+
+    Usable anywhere a Slice is: pass it to another Func, Cogroup it, etc.
+    The compiler reuses its tasks directly (inserting shuffle adapters as
+    needed). Reading re-evaluates lost tasks first — post-run fault
+    tolerance for result scans (newEvalReader, exec/bigmachine.go:1485-1535).
+    """
+
+    def __init__(self, session: "Session", slice_: Slice,
+                 tasks: Sequence[Task]):
+        super().__init__(slice_.schema, len(tasks), make_name("result"))
+        self.session = session
+        self.tasks = list(tasks)
+        self.scope = metrics_mod.Scope()
+        for t in self.tasks:
+            self.scope.merge(t.scope)
+
+    def reader(self, shard: int, deps) -> sliceio.Reader:
+        task = self.tasks[shard]
+
+        def read():
+            if task.state != TaskState.OK:
+                evaluate(self.session.executor, [task])
+            yield from self.session.executor.reader(task, 0)
+
+        return read()
+
+    # -- convenience scanning (Scanner analog, exec/session.go:407-410) ---
+
+    def frames(self) -> sliceio.Reader:
+        for shard in range(self.num_shards):
+            yield from self.reader(shard, ())
+
+    def rows(self) -> List[Tuple]:
+        out: List[Tuple] = []
+        for f in self.frames():
+            out.extend(f.rows())
+        return out
+
+    def discard(self) -> None:
+        """Drop stored task outputs (exec/session.go Discard)."""
+        for t in self.tasks:
+            self.session.executor.discard(t)
+
+
+class Session:
+    """Lifecycle + options (exec/session.go:68-176)."""
+
+    def __init__(self, executor=None, parallelism: Optional[int] = None,
+                 monitor=None):
+        if executor is None:
+            from bigslice_tpu.exec.local import LocalExecutor
+
+            executor = LocalExecutor(procs=parallelism)
+        self.executor = executor
+        self.monitor = monitor
+        self._inv_index = itertools.count(1)
+        executor.start(self)
+
+    def run(self, func: Any, *args) -> Result:
+        """Compile and evaluate ``func(*args)`` (exec/session.go:214-225).
+
+        ``func`` may be a registered ``Func``, a plain slice-returning
+        callable, or a ``Slice`` directly (test convenience, mirroring
+        slicetest.Run).
+        """
+        if isinstance(func, Func):
+            inv = func.invocation(*args)
+            slice_ = inv.invoke()
+            inv_index = inv.index
+        elif isinstance(func, Slice):
+            typecheck.check(not args, "run: args given with a literal slice")
+            slice_ = func
+            inv_index = next(self._inv_index)
+        elif callable(func):
+            slice_ = func(*args)
+            typecheck.check(
+                isinstance(slice_, Slice),
+                "run: callable returned %s, expected a Slice",
+                type(slice_).__name__,
+            )
+            inv_index = next(self._inv_index)
+        else:
+            raise typecheck.errorf(
+                "run: expected Func, Slice, or callable, got %s",
+                type(func).__name__,
+            )
+        tasks = compile_mod.Compiler(inv_index).compile(slice_)
+        evaluate(self.executor, tasks, monitor=self.monitor)
+        return Result(self, slice_, tasks)
+
+    # Go-flavored alias (Session.Must): raise on error is Python's default.
+    must = run
+
+    def shutdown(self) -> None:
+        pass
+
+
+def start(executor=None, **kwargs) -> Session:
+    """Create a session (mirrors exec.Start, exec/session.go:191-207)."""
+    return Session(executor=executor, **kwargs)

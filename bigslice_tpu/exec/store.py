@@ -1,0 +1,108 @@
+"""Task output storage: partitioned, committed result buffers.
+
+Mirrors exec/store.go: every non-pipelined task's output is materialized
+per partition, addressable by (task name, partition), and re-readable —
+this is the intra-session checkpoint mechanism (SURVEY.md §5.4(1)) that
+makes lost-task recovery and Result reuse possible.
+
+``MemoryStore`` mirrors memoryStore (exec/store.go:70-170); ``FileStore``
+mirrors fileStore (exec/store.go:173-263) with the layout
+``{prefix}/{op}/{shard}-of-{num}/p{partition}`` using the checksummed
+columnar codec. On TPU deployments the memory tier is host RAM pinned
+alongside HBM-resident working sets; the file tier is local disk or GCS.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from bigslice_tpu.frame import codec
+from bigslice_tpu.frame.frame import Frame
+from bigslice_tpu.exec.task import TaskName
+
+
+class Missing(KeyError):
+    """The requested (task, partition) output is not committed."""
+
+
+class Store:
+    def put(self, name: TaskName, partition: int, frames: List[Frame]) -> None:
+        raise NotImplementedError
+
+    def committed(self, name: TaskName, partition: int) -> bool:
+        raise NotImplementedError
+
+    def read(self, name: TaskName, partition: int) -> Iterator[Frame]:
+        raise NotImplementedError
+
+    def discard(self, name: TaskName) -> None:
+        raise NotImplementedError
+
+
+class MemoryStore(Store):
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._data: Dict[Tuple[TaskName, int], List[Frame]] = {}
+
+    def put(self, name, partition, frames):
+        with self._lock:
+            self._data[(name, partition)] = list(frames)
+
+    def committed(self, name, partition):
+        with self._lock:
+            return (name, partition) in self._data
+
+    def read(self, name, partition):
+        with self._lock:
+            frames = self._data.get((name, partition))
+        if frames is None:
+            raise Missing(f"{name} p{partition}")
+        return iter(list(frames))
+
+    def discard(self, name):
+        with self._lock:
+            for key in [k for k in self._data if k[0] == name]:
+                del self._data[key]
+
+
+class FileStore(Store):
+    def __init__(self, prefix: str):
+        self.prefix = prefix
+
+    def _path(self, name: TaskName, partition: int) -> str:
+        return os.path.join(
+            self.prefix,
+            f"inv{name.inv_index}",
+            name.op.replace("/", "_"),
+            f"{name.shard}-of-{name.num_shard}",
+            f"p{partition}",
+        )
+
+    def put(self, name, partition, frames):
+        path = self._path(name, partition)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as fp:
+            for f in frames:
+                fp.write(codec.encode_frame(f))
+        os.replace(tmp, path)
+
+    def committed(self, name, partition):
+        return os.path.exists(self._path(name, partition))
+
+    def read(self, name, partition):
+        path = self._path(name, partition)
+        if not os.path.exists(path):
+            raise Missing(f"{name} p{partition}")
+        with open(path, "rb") as fp:
+            data = fp.read()
+        return codec.read_frames(data)
+
+    def discard(self, name):
+        import shutil
+
+        d = os.path.dirname(self._path(name, 0))
+        if os.path.isdir(d):
+            shutil.rmtree(d, ignore_errors=True)

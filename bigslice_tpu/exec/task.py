@@ -1,0 +1,220 @@
+"""The task model: compiled units of work with observable runtime state.
+
+Mirrors exec/task.go: a Task is a named node in the compiled DAG with a
+``do`` closure (composed slice readers), dependencies on other tasks'
+partitioned outputs, and a mutex+condition runtime state that the
+evaluator and executors coordinate through (exec/task.go:41-72, 325-447).
+State magnitudes order task progression: INIT < WAITING < RUNNING < OK <
+ERR < LOST.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import threading
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from bigslice_tpu.utils import metrics as metrics_mod
+
+
+class TaskState(enum.IntEnum):
+    INIT = 0
+    WAITING = 1
+    RUNNING = 2
+    OK = 3
+    ERR = 4
+    LOST = 5
+
+
+class TaskError(Exception):
+    """A task failed fatally (mirrors TaskErr classification,
+    exec/bigmachine.go:441-454)."""
+
+    def __init__(self, task: "Task", cause: BaseException):
+        self.task = task
+        self.cause = cause
+        super().__init__(f"task {task.name}: {cause!r}")
+
+
+@dataclasses.dataclass(frozen=True)
+class TaskName:
+    """Unique task identity (mirrors TaskName, exec/task.go:134-160)."""
+
+    inv_index: int
+    op: str
+    shard: int
+    num_shard: int
+
+    def __str__(self) -> str:
+        return f"inv{self.inv_index}/{self.op}@{self.num_shard}:{self.shard}"
+
+
+@dataclasses.dataclass(frozen=True)
+class TaskDep:
+    """A consumer's view of producer tasks' outputs: this task reads
+    partition ``partition`` from every task in ``tasks``.
+
+    expand:      merge partition streams by sorted key instead of
+                 concatenating (Reduce semantics, reduce.go:70).
+    combine_key: nonempty when producers share a machine-level combiner
+                 buffer (MachineCombiners; exec/task.go:254-260 analog).
+    """
+
+    tasks: Tuple["Task", ...]
+    partition: int
+    expand: bool = False
+    combine_key: str = ""
+
+
+class Partitioner:
+    """Output partition configuration for a task (mirrors the compiler's
+    partitioner, exec/compile.go:52-109): how many partitions, the
+    partition function, and an optional map-side combiner."""
+
+    def __init__(self, num_partition: int = 1, partition_fn=None,
+                 combiner=None, combine_key: str = ""):
+        self.num_partition = num_partition
+        self.partition_fn = partition_fn  # fn(frame, nparts) -> int32[n]
+        self.combiner = combiner  # FrameCombiner
+        self.combine_key = combine_key
+
+    def partition_ids(self, frame, nparts: int):
+        if self.partition_fn is not None:
+            return self.partition_fn(frame, nparts)
+        return frame.partition_ids(nparts)
+
+
+class Task:
+    """A compiled, runnable node of the task graph."""
+
+    def __init__(
+        self,
+        name: TaskName,
+        do: Callable,  # fn(dep_reader_factories) -> Reader
+        deps: Sequence[TaskDep],
+        partitioner: Partitioner,
+        schema,
+        procs: int = 1,
+        exclusive: bool = False,
+        slice_names: Sequence[str] = (),
+    ):
+        self.name = name
+        self.do = do
+        self.deps = tuple(deps)
+        self.partitioner = partitioner
+        self.schema = schema
+        self.procs = procs
+        self.exclusive = exclusive
+        self.slice_names = tuple(slice_names)
+        self.scope = metrics_mod.Scope()
+
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._state = TaskState.INIT
+        self.error: Optional[BaseException] = None
+        self._subs: List[Callable] = []
+        # Evaluator bookkeeping (exec/eval.go:108-159).
+        self.consecutive_lost = 0
+
+    @property
+    def num_partition(self) -> int:
+        return self.partitioner.num_partition
+
+    @property
+    def combiner(self):
+        return self.partitioner.combiner
+
+    # -- state protocol (exec/task.go:325-447) ----------------------------
+
+    @property
+    def state(self) -> TaskState:
+        with self._lock:
+            return self._state
+
+    def set_state(self, state: TaskState,
+                  error: Optional[BaseException] = None) -> None:
+        with self._lock:
+            self._state = state
+            if error is not None:
+                self.error = error
+            if state == TaskState.OK:
+                self.error = None
+            subs = list(self._subs)
+            self._cond.notify_all()
+        for fn in subs:
+            fn(self, state)
+
+    def transition_if(self, frm: TaskState, to: TaskState) -> bool:
+        """Atomically advance frm→to; returns False if state changed."""
+        with self._lock:
+            if self._state != frm:
+                return False
+            self._state = to
+            self._cond.notify_all()
+            subs = list(self._subs)
+        for fn in subs:
+            fn(self, to)
+        return True
+
+    def wait_state(self, minimum: TaskState, timeout: Optional[float] = None
+                   ) -> TaskState:
+        """Block until state >= minimum (exec/task.go:382-407)."""
+        with self._lock:
+            self._cond.wait_for(lambda: self._state >= minimum,
+                                timeout=timeout)
+            return self._state
+
+    def mark_ok(self) -> None:
+        with self._lock:
+            self.consecutive_lost = 0
+        self.set_state(TaskState.OK)
+
+    def mark_lost(self, error: Optional[BaseException] = None) -> None:
+        """Record a loss (machine failure / missing output); the evaluator
+        resubmits lost tasks up to a consecutive-loss cap
+        (exec/eval.go:30, 139-159)."""
+        with self._lock:
+            self.consecutive_lost += 1
+        self.set_state(TaskState.LOST, error)
+
+    def subscribe(self, fn: Callable) -> None:
+        """fn(task, state) on every transition (exec/task.go:165-211)."""
+        with self._lock:
+            self._subs.append(fn)
+
+    def unsubscribe(self, fn: Callable) -> None:
+        with self._lock:
+            try:
+                self._subs.remove(fn)
+            except ValueError:
+                pass
+
+    def all_dep_tasks(self):
+        seen = []
+        for dep in self.deps:
+            seen.extend(dep.tasks)
+        return seen
+
+    def __repr__(self) -> str:
+        return f"Task({self.name}, {self.state.name})"
+
+
+def iter_tasks(roots: Sequence[Task]):
+    """Post-order DFS over the task graph, each task once
+    (mirrors iterTasks, exec/slicestatus.go:50-81)."""
+    seen = set()
+    out: List[Task] = []
+
+    def walk(t: Task):
+        if id(t) in seen:
+            return
+        seen.add(id(t))
+        for dep in t.deps:
+            for d in dep.tasks:
+                walk(d)
+        out.append(t)
+
+    for r in roots:
+        walk(r)
+    return out

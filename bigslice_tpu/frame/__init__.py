@@ -1,0 +1,4 @@
+from bigslice_tpu.frame.frame import Frame
+from bigslice_tpu.frame import ops
+
+__all__ = ["Frame", "ops"]

@@ -1,0 +1,204 @@
+"""The Slice abstraction: typed, sharded, columnar datasets.
+
+Mirrors the reference's ``Slice`` interface (slice.go:78-105): a slice has a
+schema (column types + key prefix), a shard count, dependencies (possibly
+shuffled), an optional combiner, and a per-shard reader that composes over
+its dependencies' readers. The planner (exec/compile.py) fuses shuffle-free
+chains of slices into single tasks — the XLA analog being that a fused chain
+becomes one traced program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import os
+import threading
+from typing import Callable, Optional, Sequence, Tuple
+
+from bigslice_tpu import typecheck
+from bigslice_tpu.slicetype import Schema
+from bigslice_tpu.sliceio import Reader, ReaderFactory
+
+# Shard classes (mirrors slice.go:54-62).
+HASH_SHARD = "hash"
+RANGE_SHARD = "range"
+
+
+@dataclasses.dataclass(frozen=True)
+class Name:
+    """A unique, human-readable slice name (mirrors bigslice.Name,
+    slice.go:1097-1155): operation + caller file:line + per-op index."""
+
+    op: str
+    file: str = ""
+    line: int = 0
+    index: int = 0
+
+    def __str__(self) -> str:
+        base = self.op
+        if self.file:
+            base = f"{base}@{os.path.basename(self.file)}:{self.line}"
+        if self.index:
+            base = f"{base}#{self.index}"
+        return base
+
+
+_name_lock = threading.Lock()
+_name_counters = {}
+
+
+def make_name(op: str) -> Name:
+    loc = typecheck.caller_location()
+    file, line = loc if loc else ("", 0)
+    with _name_lock:
+        key = (op, file, line)
+        idx = _name_counters.get(key, 0)
+        _name_counters[key] = idx + 1
+    return Name(op, file, line, idx)
+
+
+@dataclasses.dataclass(frozen=True)
+class Dep:
+    """A dependency on another slice (mirrors bigslice.Dep, slice.go:40-49).
+
+    shuffle:     records are hash-partitioned by key prefix before this
+                 slice reads them (lowered to all_to_all on the mesh path).
+    partitioner: optional custom partition function
+                 ``fn(frame, nparts) -> int32[n]`` (Repartition).
+    expand:      partition streams are *merged by sorted key* rather than
+                 concatenated (Reduce-style consumers).
+    """
+
+    slice: "Slice"
+    shuffle: bool = False
+    partitioner: Optional[Callable] = None
+    expand: bool = False
+
+
+class Combiner:
+    """An associative per-key value combiner (mirrors Slice.Combiner,
+    reduce.go:61-78).
+
+    ``fn`` combines two rows' value columns: ``fn(a_vals, b_vals) ->
+    vals`` where each side is a tuple of per-column values. When ``fn`` is
+    jax-traceable over scalars it also serves as the elementwise combine in
+    the device-tier sort+segmented-reduce kernel (parallel/segment.py) —
+    the TPU replacement for the reference's combiningFrame hash table
+    (exec/combiner.go:56-99).
+    """
+
+    def __init__(self, fn: Callable, name: str = "combine"):
+        self.fn = fn
+        self.name = name
+
+    def __repr__(self):
+        return f"Combiner({self.name})"
+
+
+class Pragma:
+    """Execution hints (mirrors bigslice.Pragma, slice.go:107-200)."""
+
+    @property
+    def procs(self) -> int:
+        return 1
+
+    @property
+    def exclusive(self) -> bool:
+        return False
+
+    @property
+    def materialize(self) -> bool:
+        return False
+
+
+class Procs(Pragma):
+    """Declare a task needs n procs (slice.go:131-140)."""
+
+    def __init__(self, n: int):
+        self._n = max(1, n)
+
+    @property
+    def procs(self) -> int:
+        return self._n
+
+
+class Exclusive(Pragma):
+    """Task must run exclusively on its worker (slice.go:122-129)."""
+
+    @property
+    def exclusive(self) -> bool:
+        return True
+
+
+class Materialize(Pragma):
+    """Break pipelining: materialize this slice's output
+    (ExperimentalMaterialize, slice.go:160-200)."""
+
+    @property
+    def materialize(self) -> bool:
+        return True
+
+
+class Slice(Pragma):
+    """Base class for all slice operators."""
+
+    def __init__(self, schema: Schema, num_shards: int, name: Name,
+                 pragmas: Sequence[Pragma] = ()):
+        self.schema = schema
+        self.num_shards = num_shards
+        self.name = name
+        self.pragmas = tuple(pragmas)
+        self.shard_class = HASH_SHARD
+
+    # -- pragma aggregation (mirrors Pragmas composite, slice.go:142-158) --
+
+    @property
+    def procs(self) -> int:
+        return max([1] + [p.procs for p in self.pragmas])
+
+    @property
+    def exclusive(self) -> bool:
+        return any(p.exclusive for p in self.pragmas)
+
+    @property
+    def materialize(self) -> bool:
+        return any(p.materialize for p in self.pragmas)
+
+    # -- the Slice interface ----------------------------------------------
+
+    def deps(self) -> Tuple[Dep, ...]:
+        return ()
+
+    def combiner(self) -> Optional[Combiner]:
+        return None
+
+    def reader(self, shard: int, deps: Sequence[ReaderFactory]) -> Reader:
+        """Produce this slice's output for ``shard`` given one reader
+        factory per dependency (mirrors Slice.Reader, slice.go:100-104)."""
+        raise NotImplementedError
+
+    # -- conveniences ------------------------------------------------------
+
+    @property
+    def prefix(self) -> int:
+        return self.schema.prefix
+
+    def __repr__(self) -> str:
+        types = ", ".join(repr(c) for c in self.schema)
+        return f"{self.name.op}<{types}>"
+
+
+def unwrap(slice_: Slice) -> Slice:
+    """Strip type-amending wrappers (mirrors bigslice.Unwrap,
+    slice.go:1066-1071)."""
+    from bigslice_tpu.ops.mapops import _PrefixedSlice
+
+    while isinstance(slice_, _PrefixedSlice):
+        slice_ = slice_.dep_slice
+    return slice_
+
+
+def single_dep(slice_: Slice, shuffle: bool = False, expand: bool = False,
+               partitioner=None) -> Tuple[Dep, ...]:
+    return (Dep(slice_, shuffle, partitioner, expand),)

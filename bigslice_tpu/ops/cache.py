@@ -1,0 +1,144 @@
+"""File-backed per-shard result caching.
+
+Mirrors bigslice.Cache/CachePartial/ReadCache (cache.go:45-99) and the
+FileShardCache layout ``{prefix}-NNNN-of-MMMM`` (internal/
+slicecache/slicecache.go:38-121): a slice's per-shard output is persisted
+at a user-named path prefix; on re-run, cached shards short-circuit their
+entire dependency subgraph (deps are dropped at compile time). Cache
+consistency across code changes is the user's responsibility
+(cache.go:36-43).
+
+Files use the checksummed columnar codec (frame/codec.py). Paths may be
+local or any fsspec-style mount; GCS arrives with the file driver.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Optional
+
+from bigslice_tpu import typecheck
+from bigslice_tpu.frame import codec
+from bigslice_tpu import sliceio
+from bigslice_tpu.ops.base import Dep, Slice, make_name
+
+
+def shard_path(prefix: str, shard: int, num_shards: int) -> str:
+    return f"{prefix}-{shard:04d}-of-{num_shards:04d}"
+
+
+class ShardCache:
+    """Presence map + read/write for one cache prefix (mirrors
+    FileShardCache, internal/slicecache/slicecache.go:38)."""
+
+    def __init__(self, prefix: str, num_shards: int):
+        self.prefix = prefix
+        self.num_shards = num_shards
+        self.present = [
+            os.path.exists(shard_path(prefix, s, num_shards))
+            for s in range(num_shards)
+        ]
+
+    @property
+    def all_cached(self) -> bool:
+        return all(self.present)
+
+    def is_cached(self, shard: int) -> bool:
+        return self.present[shard]
+
+    def read(self, shard: int):
+        with open(shard_path(self.prefix, shard, self.num_shards), "rb") as fp:
+            data = fp.read()
+        yield from codec.read_frames(data)
+
+    def writethrough(self, shard: int, reader):
+        """Tee a shard stream into the cache file, atomically."""
+        path = shard_path(self.prefix, shard, self.num_shards)
+        d = os.path.dirname(path) or "."
+        os.makedirs(d, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=d, prefix=".cache-")
+        ok = False
+        try:
+            with os.fdopen(fd, "wb") as fp:
+                for f in reader:
+                    fp.write(codec.encode_frame(f))
+                    yield f
+            os.replace(tmp, path)
+            ok = True
+        finally:
+            if not ok and os.path.exists(tmp):
+                os.unlink(tmp)
+
+
+class _CachedSlice(Slice):
+    """Wraps a slice with cache read/writethrough behavior per shard."""
+
+    def __init__(self, slice_: Slice, cache: ShardCache, require_all: bool,
+                 op: str):
+        super().__init__(slice_.schema, slice_.num_shards, make_name(op),
+                         pragmas=slice_.pragmas)
+        self.dep_slice = slice_
+        self.cache = cache
+        # All-or-nothing (Cache) vs per-shard (CachePartial) semantics
+        # (slicecache.go:85-97 RequireAllCached).
+        self.use_cache = (
+            cache.all_cached if require_all
+            else None  # per-shard decision
+        )
+
+    def _shard_cached(self, shard: int) -> bool:
+        if self.use_cache is not None:
+            return self.use_cache
+        return self.cache.is_cached(shard)
+
+    def deps(self):
+        # When every shard this slice computes is served from cache the
+        # dependency subgraph is dropped entirely — the compile-time
+        # short-circuit (exec/compile.go:344-368).
+        if self.use_cache is True:
+            return ()
+        return (Dep(self.dep_slice),)
+
+    def reader(self, shard, deps):
+        if self._shard_cached(shard):
+            return self.cache.read(shard)
+        return self.cache.writethrough(shard, deps[0]())
+
+
+def Cache(slice_: Slice, prefix: str) -> Slice:
+    """All-or-nothing cache (cache.go:45-50): shortcut only when every
+    shard is present."""
+    cache = ShardCache(prefix, slice_.num_shards)
+    return _CachedSlice(slice_, cache, require_all=True, op="cache")
+
+
+def CachePartial(slice_: Slice, prefix: str) -> Slice:
+    """Per-shard cache (cache.go:63-86): cached shards read back, missing
+    shards recompute and write through."""
+    cache = ShardCache(prefix, slice_.num_shards)
+    return _CachedSlice(slice_, cache, require_all=False, op="cachepartial")
+
+
+class _ReadCacheSlice(Slice):
+    def __init__(self, schema, num_shards: int, cache: ShardCache):
+        super().__init__(schema, num_shards, make_name("readcache"))
+        self.cache = cache
+
+    def reader(self, shard, deps):
+        return self.cache.read(shard)
+
+
+def ReadCache(schema, num_shards: int, prefix: str) -> Slice:
+    """Read a cache written by a previous session without recomputing
+    (cache.go:91-95); every shard must be present."""
+    from bigslice_tpu.slicetype import Schema
+
+    if not isinstance(schema, Schema):
+        schema = Schema(schema)
+    cache = ShardCache(prefix, num_shards)
+    typecheck.check(
+        cache.all_cached,
+        "readcache: missing cached shards under prefix %s", prefix,
+    )
+    return _ReadCacheSlice(schema, num_shards, cache)

@@ -1,0 +1,41 @@
+"""Const — in-memory literal slices (mirrors bigslice.Const, slice.go:212-290)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from bigslice_tpu import typecheck
+from bigslice_tpu.slicetype import Schema
+from bigslice_tpu.frame.frame import Frame
+from bigslice_tpu import sliceio
+from bigslice_tpu.ops.base import Slice, make_name
+
+
+class Const(Slice):
+    """A slice of literal columns, rows split evenly across shards
+    (slice.go:263-277).
+
+    ``Const(nshards, col0, col1, ..., prefix=1)`` — each column a sequence
+    (list/numpy/jax array). Numeric columns become device columns.
+    """
+
+    def __init__(self, num_shards: int, *cols, prefix: int = 1,
+                 schema: Optional[Schema] = None):
+        typecheck.check(num_shards >= 1, "const: num_shards must be >= 1")
+        typecheck.check(len(cols) > 0, "const: must have at least one column")
+        frame = Frame(list(cols), schema=schema, prefix=prefix)
+        super().__init__(frame.schema, num_shards, make_name("const"))
+        self.frame = frame
+
+    def reader(self, shard, deps):
+        n = len(self.frame)
+        # Even split with remainder spread over the first shards
+        # (mirrors slice.go:263-277).
+        base, extra = divmod(n, self.num_shards)
+        start = shard * base + min(shard, extra)
+        end = start + base + (1 if shard < extra else 0)
+        if start >= end:
+            return sliceio.empty_reader()
+        return sliceio.frame_reader(self.frame.slice(start, end))

@@ -1,0 +1,88 @@
+"""Fold — keyed sequential aggregation with a typed accumulator.
+
+Mirrors bigslice.Fold (slice.go:870-955): requires a shuffle dep; each
+shard accumulates ``acc = fn(acc, *values)`` per key and emits
+``(key, acc)``. Unlike Reduce, the fold function is *not* required to be
+associative, so it cannot be map-side combined (slice.go:885) and runs
+host-tier per shard (the reference's typed accumulator maps, accum.go:20-186,
+become a Python dict here; a device-tier sorted-fold can be layered on for
+traceable fns later).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import numpy as np
+
+from bigslice_tpu import typecheck
+from bigslice_tpu.slicetype import ColType, Schema
+from bigslice_tpu.frame.frame import Frame
+from bigslice_tpu import sliceio
+from bigslice_tpu.ops.base import Dep, Slice, make_name
+
+
+class Fold(Slice):
+    """``Fold(slice, fn, init, out_value)``.
+
+    ``fn(acc, *vals) -> acc``; ``init`` is the zero accumulator (a value or
+    a zero-arg callable); ``out_value`` declares the accumulator column
+    type (defaults to the first value column's type).
+    """
+
+    def __init__(self, slice_: Slice, fn: Callable, init: Any = 0,
+                 out_value=None):
+        typecheck.check(
+            slice_.prefix >= 1, "fold: input slice must have a key prefix"
+        )
+        typecheck.check(
+            len(slice_.schema) > slice_.prefix,
+            "fold: input slice must have value columns",
+        )
+        from bigslice_tpu.frame import ops as frame_ops
+
+        for ct in slice_.schema.key:
+            typecheck.check(
+                frame_ops.can_hash(ct),
+                "fold: key column type %s is not partitionable", ct,
+            )
+        acc_type = (
+            out_value
+            if out_value is not None
+            else slice_.schema.cols[slice_.prefix]
+        )
+        schema = Schema(
+            list(slice_.schema.key) + [acc_type], prefix=slice_.prefix
+        )
+        super().__init__(schema, slice_.num_shards, make_name("fold"),
+                         pragmas=slice_.pragmas)
+        self.dep_slice = slice_
+        self.fn = fn
+        self.init = init
+
+    def deps(self):
+        return (Dep(self.dep_slice, shuffle=True),)
+
+    def _zero(self):
+        return self.init() if callable(self.init) else self.init
+
+    def reader(self, shard, deps):
+        def read():
+            acc = {}
+            order = []
+            for f in deps[0]():
+                host = f.to_host()
+                nk = host.prefix
+                for r in host.rows():
+                    k, vals = r[:nk], r[nk:]
+                    if k not in acc:
+                        acc[k] = self._zero()
+                        order.append(k)
+                    acc[k] = self.fn(acc[k], *vals)
+            rows = [k + (acc[k],) for k in order]
+            for i in range(0, len(rows), sliceio.DEFAULT_CHUNK_ROWS):
+                yield Frame.from_rows(
+                    rows[i : i + sliceio.DEFAULT_CHUNK_ROWS], self.schema
+                )
+
+        return read()

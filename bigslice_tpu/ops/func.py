@@ -1,0 +1,95 @@
+"""Func registry and invocations.
+
+Mirrors the reference's ``bigslice.Func`` machinery (func.go:19-28,
+160-343): computations are rooted in registered functions; an *invocation*
+is (func index, args, invocation index) and is the unit the session
+compiles and memoizes. In the reference the deterministic global registry
+is what lets driver and workers agree on code identity across processes;
+in the TPU build all hosts run the same SPMD Python program, so identity
+holds by construction — but the registry remains the session's compilation
+key and carries pragmas (Exclusive).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Any, Callable, Sequence, Tuple
+
+from bigslice_tpu import typecheck
+from bigslice_tpu.ops.base import Slice
+
+_registry_lock = threading.Lock()
+_registry: list = []
+_invocation_counter = itertools.count(1)
+
+
+class Invocation:
+    """A serializable record of a Func applied to arguments
+    (mirrors bigslice.Invocation, func.go:218-251)."""
+
+    def __init__(self, func: "Func", args: Tuple[Any, ...], index: int):
+        self.func = func
+        self.args = args
+        self.index = index
+
+    def invoke(self) -> Slice:
+        out = self.func.fn(*self.args)
+        if not isinstance(out, Slice):
+            raise typecheck.TypecheckError(
+                f"Func {self.func.name} returned {type(out).__name__}, "
+                f"expected a Slice"
+            )
+        return out
+
+    def __repr__(self):
+        return f"Invocation#{self.index}({self.func.name})"
+
+
+class Func:
+    """A registered slice-producing function (mirrors FuncValue,
+    func.go:160)."""
+
+    def __init__(self, fn: Callable[..., Slice], exclusive: bool = False,
+                 name: str = ""):
+        self.fn = fn
+        self.exclusive = exclusive
+        self.name = name or getattr(fn, "__name__", "func")
+        with _registry_lock:
+            self.index = len(_registry)
+            _registry.append(self)
+
+    def invocation(self, *args) -> Invocation:
+        return Invocation(self, tuple(args), next(_invocation_counter))
+
+    def __call__(self, *args) -> Slice:
+        """Direct call: build the slice DAG immediately (useful in tests)."""
+        return self.fn(*args)
+
+    def __repr__(self):
+        return f"Func#{self.index}({self.name})"
+
+
+def func(fn: Callable[..., Slice] = None, *, exclusive: bool = False):
+    """Decorator registering a slice-producing function.
+
+    Usage::
+
+        @bigslice_tpu.func
+        def wordcount(path):
+            lines = bigslice_tpu.ScanReader(8, path)
+            ...
+            return counts
+    """
+
+    def wrap(f):
+        return Func(f, exclusive=exclusive)
+
+    if fn is not None:
+        return wrap(fn)
+    return wrap
+
+
+def registered() -> Sequence[Func]:
+    with _registry_lock:
+        return tuple(_registry)

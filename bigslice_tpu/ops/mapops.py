@@ -1,0 +1,296 @@
+"""Row-wise combinators: Map, Filter, Flatmap, Head, Scan, Prefixed.
+
+Mirrors slice.go's combinators. The key TPU-first change: where the
+reference calls the user function *per record via reflection*
+(slice.go:621-632 — its noted perf weakness), these combinators classify
+the user function as either
+
+- **traceable** (jax): vmapped + jitted over device columns, fused by XLA
+  into the surrounding pipeline; or
+- **host**: arbitrary Python, run batch-at-a-time on the host tier
+  (the ReaderFunc/WriterFunc class of functions — SURVEY.md §7.3(3)).
+
+Classification is automatic (``mode='auto'`` attempts an abstract jax
+trace) and overridable.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, Optional, Sequence
+
+import numpy as np
+
+from bigslice_tpu import typecheck
+from bigslice_tpu.slicetype import ColType, Schema
+from bigslice_tpu.frame.frame import Frame
+from bigslice_tpu import sliceio
+from bigslice_tpu.ops.base import (
+    Combiner,
+    Dep,
+    Slice,
+    make_name,
+    single_dep,
+)
+from bigslice_tpu.parallel.jitutil import PaddedVmap
+
+
+def _as_schema(out, default_prefix: int = 1) -> Schema:
+    if isinstance(out, Schema):
+        return out
+    cols = list(out)
+    return Schema(cols, prefix=min(default_prefix, len(cols)))
+
+
+def _try_trace(fn: Callable, in_schema: Schema):
+    """Attempt an abstract trace of fn over scalar avals of the input
+    columns. Returns the output Schema or None if fn is not traceable."""
+    if not all(ct.is_device for ct in in_schema):
+        return None
+    try:
+        import jax
+
+        specs = [jax.ShapeDtypeStruct((), ct.dtype) for ct in in_schema]
+        out = jax.eval_shape(fn, *specs)
+        if not isinstance(out, (tuple, list)):
+            out = (out,)
+        cols = []
+        for o in out:
+            if getattr(o, "shape", None) != ():
+                return None
+            cols.append(ColType(np.dtype(o.dtype)))
+        return Schema(cols, prefix=min(1, len(cols)))
+    except Exception:
+        return None
+
+
+class _Pipelined(Slice):
+    """Base for single-dep, non-shuffle (fusable) slices."""
+
+    def __init__(self, dep_slice: Slice, schema: Schema, name, pragmas=()):
+        super().__init__(schema, dep_slice.num_shards, name,
+                         pragmas=tuple(pragmas) + tuple(dep_slice.pragmas))
+        self.dep_slice = dep_slice
+
+    def deps(self):
+        return single_dep(self.dep_slice)
+
+
+class Map(_Pipelined):
+    """Per-record transform (mirrors bigslice.Map, slice.go:566-638).
+
+    ``fn(*row) -> value | tuple``. Traceable fns run vmapped+jitted on
+    device; host fns require ``out=`` (a Schema or list of column types).
+    """
+
+    def __init__(self, slice_: Slice, fn: Callable, out=None, mode="auto"):
+        name = make_name("map")
+        self.fn = fn
+        self.mode = mode
+        traced = None
+        if mode in ("auto", "jax"):
+            traced = _try_trace(fn, slice_.schema)
+        if traced is not None:
+            self.mode = "jax"
+            if out is None:
+                schema = traced
+            else:
+                # Reconcile a declared out= schema with the traced output:
+                # cast device outputs to the declared dtypes so the frame's
+                # schema never lies about its columns.
+                schema = _as_schema(out)
+                if len(schema) != len(traced):
+                    raise typecheck.errorf(
+                        "map: out= declares %d columns but function "
+                        "returns %d", len(schema), len(traced),
+                    )
+                if not all(ct.is_device for ct in schema):
+                    raise typecheck.errorf(
+                        "map: jax-traceable function cannot produce host "
+                        "columns; declare mode='host'"
+                    )
+                if tuple(c.dtype for c in schema) != tuple(
+                    c.dtype for c in traced
+                ):
+                    import jax.numpy as jnp
+
+                    base_fn, dtypes = fn, [c.dtype for c in schema]
+
+                    def fn(*args, _f=base_fn, _dts=tuple(dtypes)):
+                        o = _f(*args)
+                        if not isinstance(o, (tuple, list)):
+                            o = (o,)
+                        return tuple(
+                            jnp.asarray(v).astype(dt)
+                            for v, dt in zip(o, _dts)
+                        )
+
+            self._vfn = PaddedVmap(fn)
+        else:
+            if mode == "jax":
+                raise typecheck.errorf(
+                    "map: function is not jax-traceable over %s",
+                    slice_.schema,
+                )
+            if out is None:
+                raise typecheck.errorf(
+                    "map: host-mode function requires out= column types"
+                )
+            self.mode = "host"
+            schema = _as_schema(out)
+        super().__init__(slice_, schema, name)
+
+    def reader(self, shard, deps):
+        def read():
+            for f in deps[0]():
+                if not len(f):
+                    continue
+                if self.mode == "jax":
+                    cols, n = self._vfn(f.cols, len(f))
+                    yield Frame(cols, self.schema)
+                else:
+                    rows = [self.fn(*r) for r in f.rows()]
+                    rows = [
+                        r if isinstance(r, tuple) else (r,) for r in rows
+                    ]
+                    yield Frame.from_rows(rows, self.schema)
+
+        return read()
+
+
+class Filter(_Pipelined):
+    """Predicate filter (mirrors bigslice.Filter, slice.go:657-726)."""
+
+    def __init__(self, slice_: Slice, pred: Callable, mode="auto"):
+        name = make_name("filter")
+        self.pred = pred
+        traced = None
+        if mode in ("auto", "jax"):
+            traced = _try_trace(pred, slice_.schema)
+        if traced is not None:
+            if len(traced) != 1 or traced[0].dtype != np.dtype(np.bool_):
+                raise typecheck.errorf(
+                    "filter: predicate must return bool, got %s", traced
+                )
+            self.mode = "jax"
+            self._vfn = PaddedVmap(pred)
+        else:
+            if mode == "jax":
+                raise typecheck.errorf("filter: predicate not jax-traceable")
+            self.mode = "host"
+        super().__init__(slice_, slice_.schema, name)
+
+    def reader(self, shard, deps):
+        def read():
+            for f in deps[0]():
+                if not len(f):
+                    continue
+                if self.mode == "jax":
+                    (mask,), _ = self._vfn(f.cols, len(f))
+                    idx = np.flatnonzero(np.asarray(mask))
+                else:
+                    idx = np.fromiter(
+                        (i for i, r in enumerate(f.rows()) if self.pred(*r)),
+                        dtype=np.int64,
+                    )
+                if len(idx):
+                    yield f.take(idx)
+
+        return read()
+
+
+class Flatmap(_Pipelined):
+    """1→N transform (mirrors bigslice.Flatmap, slice.go:745-841).
+
+    ``fn(*row)`` yields output rows (any iterable of tuples). Host-tier:
+    variable fan-out is inherently dynamic-shaped; a fixed-fanout device
+    variant can be layered on later without changing the API.
+    """
+
+    def __init__(self, slice_: Slice, fn: Callable, out):
+        name = make_name("flatmap")
+        self.fn = fn
+        super().__init__(slice_, _as_schema(out), name)
+
+    def reader(self, shard, deps):
+        def read():
+            pending = []
+            npending = 0
+            for f in deps[0]():
+                for r in f.rows():
+                    for o in self.fn(*r):
+                        pending.append(o if isinstance(o, tuple) else (o,))
+                        npending += 1
+                    if npending >= sliceio.DEFAULT_CHUNK_ROWS:
+                        yield Frame.from_rows(pending, self.schema)
+                        pending, npending = [], 0
+            if pending:
+                yield Frame.from_rows(pending, self.schema)
+
+        return read()
+
+
+class Head(_Pipelined):
+    """First n rows of each shard (mirrors bigslice.Head, slice.go:966)."""
+
+    def __init__(self, slice_: Slice, n: int):
+        super().__init__(slice_, slice_.schema, make_name("head"))
+        self.n = n
+
+    def reader(self, shard, deps):
+        def read():
+            left = self.n
+            for f in deps[0]():
+                if left <= 0:
+                    break
+                take = min(left, len(f))
+                if take:
+                    yield f.slice(0, take)
+                left -= take
+
+        return read()
+
+
+class Scan(_Pipelined):
+    """Terminal per-shard sink (mirrors bigslice.Scan, slice.go:1005):
+    ``fn(shard, reader)`` consumes the shard's stream; the resulting slice
+    is empty."""
+
+    def __init__(self, slice_: Slice, fn: Callable):
+        super().__init__(slice_, slice_.schema, make_name("scan"))
+        self.fn = fn
+
+    def reader(self, shard, deps):
+        self.fn(shard, deps[0]())
+        return sliceio.empty_reader()
+
+
+class _PrefixedSlice(_Pipelined):
+    """Key-prefix widening (mirrors bigslice.Prefixed, slice.go:1044)."""
+
+    def __init__(self, slice_: Slice, prefix: int):
+        typecheck.check(prefix >= 1,
+                        "prefixed: prefix must include at least one column")
+        typecheck.check(
+            prefix <= len(slice_.schema),
+            "prefixed: prefix %d is greater than number of columns %d",
+            prefix, len(slice_.schema),
+        )
+        super().__init__(slice_, slice_.schema.with_prefix(prefix),
+                         make_name("prefixed"))
+
+    def reader(self, shard, deps):
+        def read():
+            for f in deps[0]():
+                yield Frame(f.cols, self.schema)
+
+        return read()
+
+
+def Prefixed(slice_: Slice, prefix: int) -> Slice:
+    return _PrefixedSlice(slice_, prefix)
+
+
+def Unwrap(slice_: Slice) -> Slice:
+    from bigslice_tpu.ops.base import unwrap
+
+    return unwrap(slice_)

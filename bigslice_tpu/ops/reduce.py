@@ -1,0 +1,127 @@
+"""Reduce — keyed pairwise combination with map-side combining.
+
+Mirrors bigslice.Reduce (reduce.go:42-78): the input is shuffled by key
+prefix; an associative combine function merges values per key, both
+*map-side* (in the producer task, before the shuffle — the executor applies
+``Slice.combiner()``) and *reduce-side* (in this slice's reader). The
+shuffle dep sets ``expand=True`` (reduce.go:70) so partition streams merge
+rather than concatenate.
+
+TPU lowering: the combine is the sort+segmented-scan kernel
+(parallel/segment.py) on the device tier; when keys or the function live on
+the host tier it falls back to dict combining.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+from bigslice_tpu import typecheck
+from bigslice_tpu.frame.frame import Frame
+from bigslice_tpu.slicetype import Schema
+from bigslice_tpu import sliceio
+from bigslice_tpu.ops.base import Combiner, Dep, Slice, make_name
+from bigslice_tpu.parallel import segment
+
+
+def _vals_traceable(fn: Callable, schema: Schema) -> bool:
+    """Can `fn` combine this schema's value columns on device?"""
+    if not all(ct.is_device for ct in schema):
+        return False
+    try:
+        import jax
+
+        nvals = len(schema.values)
+        cfn = segment.canonical_combine(fn, nvals)
+        specs = tuple(
+            jax.ShapeDtypeStruct((), ct.dtype) for ct in schema.values
+        )
+        out = jax.eval_shape(lambda *v: cfn(v[:nvals], v[nvals:]),
+                             *(specs + specs))
+        return all(
+            o.shape == () and np.dtype(o.dtype) == np.dtype(ct.dtype)
+            for o, ct in zip(out, schema.values)
+        )
+    except Exception:
+        return False
+
+
+class FrameCombiner:
+    """Combines frames by key; device kernel when possible, host dict
+    otherwise. This is what executors invoke for map-side combining."""
+
+    def __init__(self, fn: Callable, schema: Schema):
+        self.fn = fn
+        self.schema = schema
+        self.nkeys = schema.prefix
+        self.nvals = len(schema) - schema.prefix
+        typecheck.check(self.nvals >= 1,
+                        "reduce: slice must have at least one value column")
+        self.device = _vals_traceable(fn, schema)
+        self._kernel = (
+            segment.DeviceReduceByKey(fn, self.nkeys, self.nvals)
+            if self.device
+            else None
+        )
+
+    def combine(self, frame: Frame) -> Frame:
+        """Combine equal keys within one frame."""
+        if not len(frame):
+            return frame
+        if self._kernel is not None:
+            keys, vals = self._kernel(
+                frame.key_cols(), frame.value_cols(), len(frame)
+            )
+        else:
+            host = frame.to_host()
+            keys, vals = segment.host_reduce_by_key(
+                host.key_cols(), host.value_cols(), self.fn, self.nvals
+            )
+        return Frame(list(keys) + list(vals), self.schema)
+
+    def combine_frames(self, frames) -> Frame:
+        frames = [f for f in frames if f is not None and len(f)]
+        if not frames:
+            return Frame.empty(self.schema)
+        return self.combine(Frame.concat(frames))
+
+
+class Reduce(Slice):
+    def __init__(self, slice_: Slice, fn: Callable):
+        typecheck.check(
+            slice_.prefix >= 1, "reduce: input slice must have a key prefix"
+        )
+        typecheck.check(
+            len(slice_.schema) > slice_.prefix,
+            "reduce: input slice must have value columns",
+        )
+        for ct in slice_.schema.key:
+            from bigslice_tpu.frame import ops as frame_ops
+
+            typecheck.check(
+                frame_ops.can_hash(ct),
+                "reduce: key column type %s is not partitionable", ct,
+            )
+        super().__init__(slice_.schema, slice_.num_shards,
+                         make_name("reduce"), pragmas=slice_.pragmas)
+        self.dep_slice = slice_
+        self.fn = fn
+        self._combiner = Combiner(fn, name="reduce")
+        self.frame_combiner = FrameCombiner(fn, slice_.schema)
+
+    def deps(self):
+        return (Dep(self.dep_slice, shuffle=True, partitioner=None,
+                    expand=True),)
+
+    def combiner(self):
+        return self._combiner
+
+    def reader(self, shard, deps):
+        def read():
+            out = self.frame_combiner.combine_frames(list(deps[0]()))
+            if len(out):
+                yield out
+
+        return read()

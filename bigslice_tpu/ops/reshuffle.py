@@ -1,0 +1,71 @@
+"""Reshuffle, Repartition, Reshard — explicit data movement.
+
+Mirrors reshuffle.go:37-86 and reshard.go:15-45. On the mesh executor these
+lower to a hash-bucket kernel + ``all_to_all`` over ICI (parallel/shuffle.py);
+on the local executor they are in-memory hash partitions.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from bigslice_tpu import typecheck
+from bigslice_tpu.ops.base import Dep, Slice, make_name
+from bigslice_tpu import sliceio
+
+
+class Reshuffle(Slice):
+    """Shuffle records among shards by key prefix (reshuffle.go:37-50)."""
+
+    def __init__(self, slice_: Slice, partitioner: Optional[Callable] = None):
+        from bigslice_tpu.frame import ops as frame_ops
+
+        if partitioner is None:
+            for ct in slice_.schema.key:
+                typecheck.check(
+                    frame_ops.can_hash(ct),
+                    "reshuffle: key column type %s is not partitionable", ct,
+                )
+        super().__init__(slice_.schema, slice_.num_shards,
+                         make_name("reshuffle"), pragmas=slice_.pragmas)
+        self.dep_slice = slice_
+        self.partitioner = partitioner
+
+    def deps(self):
+        return (Dep(self.dep_slice, shuffle=True,
+                    partitioner=self.partitioner),)
+
+    def reader(self, shard, deps):
+        return deps[0]()
+
+
+def Repartition(slice_: Slice, partition: Callable) -> Slice:
+    """Reshuffle with a custom partitioner ``fn(frame, nparts) ->
+    int32[n]`` (vectorized; mirrors reshuffle.go:52-76's per-record fn,
+    lifted to columns for the device tier)."""
+    return Reshuffle(slice_, partitioner=partition)
+
+
+class Reshard(Slice):
+    """Change shard count via reshuffle; identity if equal
+    (reshard.go:15-45)."""
+
+    def __new__(cls, slice_: Slice, num_shards: int):
+        if slice_.num_shards == num_shards:
+            return slice_
+        self = object.__new__(cls)
+        return self
+
+    def __init__(self, slice_: Slice, num_shards: int):
+        if self is slice_:  # identity short-circuit hit in __new__
+            return
+        typecheck.check(num_shards >= 1, "reshard: num_shards must be >= 1")
+        super().__init__(slice_.schema, num_shards, make_name("reshard"),
+                         pragmas=slice_.pragmas)
+        self.dep_slice = slice_
+
+    def deps(self):
+        return (Dep(self.dep_slice, shuffle=True),)
+
+    def reader(self, shard, deps):
+        return deps[0]()

@@ -1,0 +1,112 @@
+"""Host-tier sources and sinks: ReaderFunc, WriterFunc, ScanReader.
+
+These are the "host function" class (SURVEY.md §7.3(3)): arbitrary Python
+doing I/O per shard, feeding the device pipelines downstream. Mirrors
+bigslice.ReaderFunc (slice.go:321-402), WriterFunc (slice.go:443-548) and
+ScanReader (scan.go:16-58).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, Optional, Union
+
+import numpy as np
+
+from bigslice_tpu import typecheck
+from bigslice_tpu.slicetype import Schema
+from bigslice_tpu.frame.frame import Frame
+from bigslice_tpu import sliceio
+from bigslice_tpu.ops.base import Slice, make_name, single_dep
+
+
+class ReaderFunc(Slice):
+    """Custom per-shard source.
+
+    ``fn(shard)`` is a generator yielding batches: either ``Frame``s or
+    tuples of column sequences. ``out`` declares the schema (the reference
+    derives it from the Go func signature, slice.go:340-360; Python needs
+    it declared).
+    """
+
+    def __init__(self, num_shards: int, fn: Callable, out,
+                 prefix: int = 1):
+        typecheck.check(num_shards >= 1, "readerfunc: num_shards must be >= 1")
+        schema = out if isinstance(out, Schema) else Schema(out, prefix)
+        super().__init__(schema, num_shards, make_name("reader"))
+        self.fn = fn
+
+    def reader(self, shard, deps):
+        def read():
+            for batch in self.fn(shard):
+                if isinstance(batch, Frame):
+                    f = Frame(batch.cols, self.schema)
+                else:
+                    f = Frame(list(batch), self.schema)
+                if len(f):
+                    yield f
+
+        return read()
+
+
+class WriterFunc(Slice):
+    """Per-shard side-effecting pass-through writer (slice.go:443-548).
+
+    ``fn(shard, frame)`` is called for every batch; rows pass through
+    unchanged. An optional ``done(shard)`` runs at stream end.
+    """
+
+    def __init__(self, slice_: Slice, fn: Callable,
+                 done: Optional[Callable] = None):
+        super().__init__(slice_.schema, slice_.num_shards,
+                         make_name("writer"), pragmas=slice_.pragmas)
+        self.dep_slice = slice_
+        self.fn = fn
+        self.done = done
+
+    def deps(self):
+        return single_dep(self.dep_slice)
+
+    def reader(self, shard, deps):
+        def read():
+            for f in deps[0]():
+                self.fn(shard, f)
+                yield f
+            if self.done is not None:
+                self.done(shard)
+
+        return read()
+
+
+class ScanReader(Slice):
+    """Line-oriented text source (mirrors bigslice.ScanReader, scan.go:16-58):
+    every shard scans the whole input, keeping lines ``i % num_shards ==
+    shard`` — simple, deterministic striping with no index."""
+
+    def __init__(self, num_shards: int, source: Union[str, Callable]):
+        typecheck.check(num_shards >= 1, "scanreader: num_shards must be >= 1")
+        super().__init__(Schema([str], prefix=1), num_shards,
+                         make_name("scanreader"))
+        self.source = source
+
+    def _lines(self):
+        if callable(self.source):
+            yield from self.source()
+        else:
+            with open(self.source, "r") as fp:
+                for line in fp:
+                    yield line.rstrip("\n")
+
+    def reader(self, shard, deps):
+        def read():
+            batch = []
+            for i, line in enumerate(self._lines()):
+                if i % self.num_shards != shard:
+                    continue
+                batch.append((line,))
+                if len(batch) >= sliceio.DEFAULT_CHUNK_ROWS:
+                    yield Frame.from_rows(batch, self.schema)
+                    batch = []
+            if batch:
+                yield Frame.from_rows(batch, self.schema)
+
+        return read()

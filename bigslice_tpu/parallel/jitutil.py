@@ -1,0 +1,70 @@
+"""Jit-friendly batching utilities.
+
+XLA compiles one program per (function, shapes) — data-dependent batch
+sizes would recompile endlessly (SURVEY.md §7.3(1)). The framework
+therefore pads ragged batches up to power-of-two *buckets* before entering
+jitted kernels and slices the valid region off afterwards: a bounded set of
+compiled programs regardless of data skew.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Sequence, Tuple
+
+import numpy as np
+
+
+def bucket_size(n: int, minimum: int = 8) -> int:
+    """Smallest power of two ≥ n (≥ minimum)."""
+    b = minimum
+    while b < n:
+        b <<= 1
+    return b
+
+
+def pad_cols(cols: Sequence, n: int, target: int) -> list:
+    """Pad columns from n to target rows by repeating the last row (stays
+    in the user function's domain, unlike zero fill).
+
+    Deliberately *numpy*: eager jnp ops would compile one tiny XLA program
+    per distinct shape — ragged batch sizes would thrash the compile
+    cache. Host padding costs a memcpy; the jitted kernel downstream is
+    the only XLA program in the path.
+    """
+    if n == target:
+        return list(cols)
+    out = []
+    for c in cols:
+        c = np.asarray(c)
+        if n == 0:
+            out.append(np.zeros((target,) + c.shape[1:], c.dtype))
+        else:
+            fill = np.broadcast_to(
+                c[n - 1 : n], (target - n,) + c.shape[1:]
+            )
+            out.append(np.concatenate([c, fill]))
+    return out
+
+
+class PaddedVmap:
+    """vmap+jit a per-row function, amortized over bucketed batch sizes."""
+
+    def __init__(self, fn: Callable, out_tuple: bool = True):
+        import jax
+
+        self.fn = fn
+        self.out_tuple = out_tuple
+        self._jitted = jax.jit(jax.vmap(fn))
+
+    def __call__(self, cols: Sequence, n: int) -> Tuple[list, int]:
+        """Apply to n valid rows of equal-length columns; returns (out
+        columns sliced to n, n)."""
+        target = bucket_size(n)
+        padded = pad_cols(cols, n, target)
+        out = self._jitted(*padded)
+        if not isinstance(out, (tuple, list)):
+            out = (out,)
+        # Slice on the host: an eager device slice would compile one XLA
+        # program per distinct n.
+        return [np.asarray(o)[:n] for o in out], n

@@ -1,0 +1,153 @@
+"""Device-tier keyed reduction: sort + segmented associative scan.
+
+This is the TPU-native replacement for the reference's open-addressed
+hash-table combiner (combiningFrame, exec/combiner.go:56-209) and its
+sortio spill/merge path: rows are sorted by key with ``lax.sort`` (multi-
+operand, stable), segment boundaries are found by adjacent-key comparison,
+and an arbitrary *associative* user combine function is applied per segment
+via a segmented ``lax.associative_scan`` — O(log n) depth, fully
+parallel, no data-dependent control flow (XLA-friendly, SURVEY.md §7.1).
+
+Ragged batch sizes are handled by bucket padding with a validity sort key:
+padded rows sort last and form their own segments, so results are exact
+for the valid region (parallel/jitutil.py rationale).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence, Tuple
+
+import numpy as np
+
+from bigslice_tpu.parallel.jitutil import bucket_size, pad_cols
+from bigslice_tpu.frame.frame import obj_col as _obj_col
+
+
+def canonical_combine(fn: Callable, nvals: int) -> Callable:
+    """Normalize a user combine fn to ``cfn(a_tuple, b_tuple) -> tuple``.
+
+    Single-value-column reduces use the natural ``fn(a, b) -> v`` form
+    (mirroring bigslice.Reduce's ``func(v, w) V``, reduce.go:42).
+    """
+    if nvals == 1:
+        return lambda a, b: (fn(a[0], b[0]),)
+
+    def cfn(a, b):
+        out = fn(a, b)
+        if not isinstance(out, tuple):
+            out = tuple(out)
+        return out
+
+    return cfn
+
+
+class DeviceReduceByKey:
+    """Jitted keyed reduction over device columns.
+
+    ``__call__(key_cols, val_cols, n)`` returns host-compacted
+    ``(key_cols, val_cols)`` with one row per distinct key, sorted by key.
+    Compiled once per (nkeys, nvals, dtypes, bucket) — the jit cache stays
+    bounded thanks to power-of-two bucketing.
+    """
+
+    def __init__(self, fn: Callable, nkeys: int, nvals: int):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        cfn = canonical_combine(fn, nvals)
+        self.nkeys = nkeys
+        self.nvals = nvals
+
+        def kernel(n, *cols):
+            keys = cols[:nkeys]
+            vals = cols[nkeys:]
+            size = cols[0].shape[0]
+            invalid = (jnp.arange(size, dtype=np.int32) >= n).astype(np.int32)
+            operands = (invalid,) + tuple(keys) + tuple(vals)
+            sorted_ops = lax.sort(operands, num_keys=1 + nkeys,
+                                  is_stable=True)
+            s_invalid = sorted_ops[0]
+            s_keys = sorted_ops[1 : 1 + nkeys]
+            s_vals = sorted_ops[1 + nkeys :]
+
+            # Segment starts: row 0, any key column change, validity change.
+            diff = jnp.zeros(size, dtype=bool).at[0].set(True)
+            for k in (s_invalid,) + tuple(s_keys):
+                diff = diff.at[1:].set(diff[1:] | (k[1:] != k[:-1]))
+            # Padded rows each form their own segment so they can't
+            # contaminate real reductions.
+            diff = diff | (s_invalid == 1)
+
+            def scan_op(x, y):
+                fx, vx = x
+                fy, vy = y
+                merged = cfn(vx, vy)
+                out = tuple(
+                    jnp.where(fy, b, m) for b, m in zip(vy, merged)
+                )
+                return (fx | fy, out)
+
+            _, red_vals = lax.associative_scan(scan_op, (diff, tuple(s_vals)))
+            is_last = jnp.ones(size, dtype=bool).at[:-1].set(diff[1:])
+            out_valid = is_last & (s_invalid == 0)
+            return s_keys, red_vals, out_valid
+
+        self._jitted = jax.jit(kernel)
+
+    def __call__(self, key_cols: Sequence, val_cols: Sequence, n: int):
+        import jax.numpy as jnp
+
+        size = bucket_size(n)
+        cols = pad_cols(list(key_cols) + list(val_cols), n, size)
+        keys, vals, valid = self._jitted(jnp.int32(n), *cols)
+        idx = np.flatnonzero(np.asarray(valid))
+        return (
+            [np.asarray(k)[idx] for k in keys],
+            [np.asarray(v)[idx] for v in vals],
+        )
+
+
+def host_reduce_by_key(key_cols, val_cols, fn, nvals: int):
+    """Host-tier fallback keyed reduction (object keys / non-traceable fn).
+
+    Dict-based single pass, mirroring the role (not the mechanics) of the
+    reference's combiningFrame.
+    """
+    cfn = canonical_combine(fn, nvals)
+    acc = {}
+    order = []
+    n = len(key_cols[0])
+    for i in range(n):
+        k = tuple(c[i] for c in key_cols)
+        v = tuple(c[i] for c in val_cols)
+        if k in acc:
+            acc[k] = cfn(acc[k], v)
+        else:
+            acc[k] = v
+            order.append(k)
+    # Emit key-sorted, matching the device kernel — combined partition
+    # streams must be sorted for the expand (merge) read path.
+    try:
+        order.sort()
+    except TypeError:
+        pass  # incomparable key types: emit in insertion order
+    keys_out = []
+    for j, col in enumerate(key_cols):
+        vals = [k[j] for k in order]
+        if getattr(col, "dtype", None) == np.dtype(object):
+            keys_out.append(_obj_col(vals))
+        else:
+            keys_out.append(np.asarray(vals, dtype=col.dtype))
+    vals_out = []
+    for j in range(nvals):
+        vals = [acc[k][j] for k in order]
+        col = val_cols[j]
+        if getattr(col, "dtype", None) == np.dtype(object):
+            vals_out.append(_obj_col(vals))
+        else:
+            vals_out.append(np.asarray(vals, dtype=col.dtype))
+    return keys_out, vals_out
+
+
+

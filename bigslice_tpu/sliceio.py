@@ -1,0 +1,131 @@
+"""Record I/O: the Reader protocol and helpers.
+
+Mirrors the reference's ``sliceio`` package (sliceio/reader.go:29-52) with a
+Python/TPU twist: a *Reader* is simply an ``Iterator[Frame]`` — a pull-based
+stream of columnar batches. Vectorization is inherent (batches, not rows),
+and the batch is the unit that crosses the host↔device boundary.
+
+A *ReaderFactory* is a zero-arg callable producing a fresh Reader; task
+``Do`` closures compose these (exec/compile.go:338-385 analog).
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Callable, Iterable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from bigslice_tpu.frame.frame import Frame
+from bigslice_tpu.slicetype import Schema
+
+Reader = Iterator[Frame]
+ReaderFactory = Callable[[], Reader]
+
+# Default batch size for host-tier sources, mirroring
+# internal/defaultsize.Chunk (internal/defaultsize/size.go:14-19). Device
+# pipelines want far larger batches; executors re-batch at the boundary.
+DEFAULT_CHUNK_ROWS = 4096
+
+
+def empty_reader() -> Reader:
+    return iter(())
+
+
+def frame_reader(frame: Frame, chunk: Optional[int] = None) -> Reader:
+    """Stream a frame in chunks (mirrors sliceio.FrameReader)."""
+    if chunk is None or chunk >= len(frame):
+        if len(frame):
+            yield frame
+        return
+    for i in range(0, len(frame), chunk):
+        yield frame.slice(i, min(i + chunk, len(frame)))
+
+
+def multi_reader(readers: Sequence[Reader]) -> Reader:
+    """Concatenate readers (mirrors sliceio.MultiReader, sliceio/reader.go:80)."""
+    for r in readers:
+        yield from r
+
+
+def read_all(reader: Reader, schema: Optional[Schema] = None) -> Frame:
+    """Drain a reader into a single frame (mirrors sliceio.ReadAll)."""
+    frames = [f for f in reader if len(f)]
+    if not frames:
+        if schema is None:
+            raise ValueError("read_all of empty reader with no schema")
+        return Frame.empty(schema)
+    return Frame.concat(frames)
+
+
+def rebatch(reader: Reader, rows: int) -> Reader:
+    """Re-chunk a stream to batches of ~`rows` rows. Used at the host→device
+    boundary to feed XLA pipelines large, uniform batches (static shapes
+    keep the jit cache warm — SURVEY.md §7.3(1))."""
+    pending: List[Frame] = []
+    have = 0
+    for f in reader:
+        if not len(f):
+            continue
+        pending.append(f)
+        have += len(f)
+        while have >= rows:
+            merged = Frame.concat(pending)
+            yield merged.slice(0, rows)
+            rest = merged.slice(rows, len(merged))
+            pending = [rest] if len(rest) else []
+            have = len(rest)
+    if have:
+        yield Frame.concat(pending)
+
+
+def merge_reader(readers: Sequence[Reader], schema: Schema) -> Reader:
+    """Streaming k-way merge of key-sorted readers (mirrors
+    sortio.NewMergeReader, sortio/sort.go:154-216).
+
+    Host-tier merge used when combining spilled/sorted partition streams;
+    the device-tier equivalent is a sharded lax.sort (parallel/sortops.py).
+    """
+    # Buffered cursor per reader: (frames exhausted lazily, row index).
+    cursors = []
+    for r in readers:
+        f = _next_nonempty(r)
+        if f is not None:
+            cursors.append([f.to_host(), 0, r])
+    if not cursors:
+        return
+    prefix = schema.prefix
+
+    def keyat(cur):
+        f, i, _ = cur
+        return tuple(c[i] for c in f.cols[:prefix])
+
+    heap = [(keyat(c), j) for j, c in enumerate(cursors)]
+    heapq.heapify(heap)
+    out_rows = []
+    while heap:
+        _, j = heapq.heappop(heap)
+        cur = cursors[j]
+        f, i, r = cur
+        out_rows.append(tuple(col[i] for col in f.cols))
+        if len(out_rows) >= DEFAULT_CHUNK_ROWS:
+            yield Frame.from_rows(out_rows, schema)
+            out_rows = []
+        i += 1
+        if i >= len(f):
+            nf = _next_nonempty(r)
+            if nf is None:
+                continue
+            cur[0], cur[1] = nf.to_host(), 0
+        else:
+            cur[1] = i
+        heapq.heappush(heap, (keyat(cur), j))
+    if out_rows:
+        yield Frame.from_rows(out_rows, schema)
+
+
+def _next_nonempty(r: Reader) -> Optional[Frame]:
+    for f in r:
+        if len(f):
+            return f
+    return None

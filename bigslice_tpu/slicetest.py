@@ -1,0 +1,38 @@
+"""Test harness: run slices in a local session and scan results.
+
+Mirrors the reference's ``slicetest`` package (slicetest/run.go:24-94):
+local-mode Run/ScanAll conveniences used throughout the test suite and by
+user smoke tests.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+from bigslice_tpu.exec.session import Result, Session
+
+
+def run(func_or_slice: Any, *args, session: Optional[Session] = None
+        ) -> Result:
+    sess = session or Session()
+    return sess.run(func_or_slice, *args)
+
+
+def scan_all(func_or_slice: Any, *args,
+             session: Optional[Session] = None) -> List[Tuple]:
+    return run(func_or_slice, *args, session=session).rows()
+
+
+def sorted_rows(func_or_slice: Any, *args,
+                session: Optional[Session] = None) -> List[Tuple]:
+    """Rows in deterministic (sorted) order, for assertion convenience —
+    shard/partition order is not meaningful."""
+    return sorted(scan_all(func_or_slice, *args, session=session),
+                  key=_row_key)
+
+
+def _row_key(row: Tuple):
+    return tuple(
+        (str(type(v)), v) if not isinstance(v, (list, tuple)) else
+        (str(type(v)), tuple(v)) for v in row
+    )

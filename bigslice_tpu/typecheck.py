@@ -1,0 +1,62 @@
+"""Eager type checking with caller-attributed errors.
+
+Mirrors the reference's ``typecheck`` package: combinator constructors check
+schemas eagerly and raise errors carrying the *user's* source location
+(typecheck/error.go:20-99), not the framework internals — so a bad ``Map``
+function is reported at the line that called ``Map``.
+"""
+
+from __future__ import annotations
+
+import inspect
+import os
+from typing import Optional, Tuple
+
+
+class TypecheckError(TypeError):
+    """A type error attributed to user code.
+
+    Carries (file, line) of the offending combinator call, like the
+    reference's typecheck panics (typecheck/error.go:20-34).
+    """
+
+    def __init__(self, msg: str, location: Optional[Tuple[str, int]] = None):
+        self.location = location
+        if location:
+            file, line = location
+            msg = f"{os.path.basename(file)}:{line}: {msg}"
+        super().__init__(msg)
+
+
+def caller_location(depth: int = 1) -> Optional[Tuple[str, int]]:
+    """(file, line) of the caller ``depth`` frames above the framework.
+
+    Frames inside bigslice_tpu itself are skipped, mirroring
+    ``bigslice.Helper()`` attribution (slice.go:1114-1155): helpers that
+    wrap combinators are attributed to *their* callers.
+    """
+    pkg_dir = os.path.dirname(os.path.abspath(__file__))
+    frame = inspect.currentframe()
+    try:
+        f = frame.f_back
+        skipped = 0
+        while f is not None:
+            fname = f.f_code.co_filename
+            if not os.path.abspath(fname).startswith(pkg_dir):
+                skipped += 1
+                if skipped >= depth:
+                    return (fname, f.f_lineno)
+            f = f.f_back
+        return None
+    finally:
+        del frame
+
+
+def errorf(fmt: str, *args) -> TypecheckError:
+    """Build a TypecheckError attributed to the nearest user frame."""
+    return TypecheckError(fmt % args if args else fmt, caller_location())
+
+
+def check(cond: bool, fmt: str, *args) -> None:
+    if not cond:
+        raise errorf(fmt, *args)

@@ -1,0 +1,107 @@
+"""User-facing metrics: counters aggregated task → session.
+
+Mirrors the reference's ``metrics`` package (metrics/metrics.go:33-126,
+metrics/scope.go:17-152): users create named counters in a global registry;
+each task carries a *Scope* of counter values, incremented from inside user
+functions and merged into the session result's scope as tasks complete.
+Python's GIL plus a lock replace the reference's lock-free persistent
+structure; values are plain ints (serializable for cross-host shipping).
+"""
+
+from __future__ import annotations
+
+import contextvars
+import itertools
+import threading
+from typing import Dict, Optional
+
+_registry_lock = threading.Lock()
+_counters: list = []
+
+
+class Counter:
+    """A named user counter (mirrors metrics.NewCounter,
+    metrics/metrics.go:63)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        with _registry_lock:
+            self.index = len(_counters)
+            _counters.append(self)
+
+    def incr(self, n: int = 1) -> None:
+        scope = current_scope()
+        if scope is not None:
+            scope.incr(self, n)
+
+    def value(self, scope: "Scope") -> int:
+        return scope.value(self)
+
+    def __repr__(self):
+        return f"Counter({self.name})"
+
+
+def new_counter(name: str) -> Counter:
+    return Counter(name)
+
+
+class Scope:
+    """A set of counter values, mergeable (metrics/scope.go:17)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._values: Dict[int, int] = {}
+
+    def incr(self, counter: Counter, n: int = 1) -> None:
+        with self._lock:
+            self._values[counter.index] = (
+                self._values.get(counter.index, 0) + n
+            )
+
+    def value(self, counter: Counter) -> int:
+        with self._lock:
+            return self._values.get(counter.index, 0)
+
+    def merge(self, other: "Scope") -> None:
+        with other._lock:
+            items = list(other._values.items())
+        with self._lock:
+            for k, v in items:
+                self._values[k] = self._values.get(k, 0) + v
+
+    def reset(self) -> None:
+        with self._lock:
+            self._values.clear()
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                _counters[i].name: v for i, v in self._values.items()
+            }
+
+
+# Context propagation into user functions (metrics/scope.go:150:
+# ContextScope); executors install the running task's scope here.
+_current: contextvars.ContextVar[Optional[Scope]] = contextvars.ContextVar(
+    "bigslice_tpu_metrics_scope", default=None
+)
+
+
+def current_scope() -> Optional[Scope]:
+    return _current.get()
+
+
+class scope_context:
+    """Context manager installing a scope for user-function calls."""
+
+    def __init__(self, scope: Scope):
+        self.scope = scope
+        self._token = None
+
+    def __enter__(self):
+        self._token = _current.set(self.scope)
+        return self.scope
+
+    def __exit__(self, *exc):
+        _current.reset(self._token)
+        return False

@@ -1,0 +1,34 @@
+"""Test configuration: force an 8-device virtual CPU platform.
+
+Multi-chip TPU hardware is not available in CI; sharding and collective
+paths are validated on 8 virtual CPU devices, mirroring the reference's
+in-process fake cluster strategy (bigmachine/testsystem,
+exec/slicemachine_test.go:299-310): the full distributed control path runs
+hermetically in unit tests.
+"""
+
+import os
+
+# Hard-set, not setdefault: the ambient environment points JAX at the real
+# TPU (JAX_PLATFORMS=axon); unit tests must run hermetically on virtual
+# CPU devices regardless.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# The TPU-tunnel plugin (axon) registers a backend factory at interpreter
+# start via sitecustomize (importing jax in the process, so the env vars
+# above are too late for jax.config) and pins jax_platforms to the tunnel
+# — a wedged tunnel then hangs every test. Deregister it and repin the
+# config; tests never touch real TPU hardware.
+try:
+    import jax
+    from jax._src import xla_bridge as _xb
+
+    _xb._backend_factories.pop("axon", None)
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass

@@ -1,0 +1,143 @@
+"""Compiler tests: pipeline fusion, memoization, golden task graphs.
+
+Mirrors exec/compile_test.go + exec/testdata/*.graph: the task-DAG shape
+is pinned, not just behavior.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import bigslice_tpu as bs
+from bigslice_tpu.exec import compile as compile_mod
+from bigslice_tpu.exec.task import iter_tasks
+
+GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "testdata")
+
+
+def graph(slice_):
+    tasks = compile_mod.Compiler(1).compile(slice_)
+    return compile_mod.graph_string(tasks, locations=False)
+
+
+def check_golden(name, text):
+    path = os.path.join(GOLDEN_DIR, name + ".graph")
+    if os.environ.get("UPDATE_GOLDEN"):
+        os.makedirs(GOLDEN_DIR, exist_ok=True)
+        with open(path, "w") as fp:
+            fp.write(text)
+    with open(path) as fp:
+        assert fp.read() == text, f"golden mismatch for {name}"
+
+
+def test_fusion_single_task_per_shard():
+    s = bs.Const(3, np.arange(10, dtype=np.int32))
+    m = bs.Map(s, lambda x: x + 1)
+    f = bs.Filter(m, lambda x: x > 2)
+    m2 = bs.Map(f, lambda x: x * 2)
+    tasks = compile_mod.Compiler(1).compile(m2)
+    assert len(tasks) == 3
+    # Fully fused: no dependencies.
+    assert all(not t.deps for t in tasks)
+    assert all("map" in t.name.op and "filter" in t.name.op
+               and "const" in t.name.op for t in tasks)
+
+
+def test_shuffle_breaks_pipeline():
+    s = bs.Const(2, np.arange(10, dtype=np.int32),
+                 np.ones(10, dtype=np.int32))
+    r = bs.Reduce(s, lambda a, b: a + b)
+    tasks = compile_mod.Compiler(1).compile(r)
+    assert len(tasks) == 2
+    all_tasks = iter_tasks(tasks)
+    assert len(all_tasks) == 4  # 2 producer + 2 reducer
+    producers = [t for t in all_tasks if t.num_partition == 2]
+    assert len(producers) == 2
+    assert all(t.combiner is not None for t in producers)
+    # Reducer deps read their shard's partition from all producers.
+    for shard, t in enumerate(tasks):
+        assert len(t.deps) == 1
+        assert t.deps[0].partition == shard
+        assert t.deps[0].expand
+        assert len(t.deps[0].tasks) == 2
+
+
+def test_memoization_diamond():
+    s = bs.Const(2, np.arange(10, dtype=np.int32))
+    m = bs.Map(s, lambda x: (x % 2, x))
+    add = lambda x, y: x + y  # noqa: E731 — shared so combiners key equal
+    a = bs.Reduce(m, add)
+    b = bs.Reduce(m, add)
+    cg = bs.Cogroup(a, b)
+    c = compile_mod.Compiler(1)
+    tasks = c.compile(cg)
+    all_tasks = iter_tasks(tasks)
+    # The shared producer chain (const_map) must be compiled once per
+    # (partition, combiner) config, not duplicated per identical consumer.
+    prod_ops = [t.name.op for t in all_tasks if "const" in t.name.op]
+    assert len(prod_ops) == len(set(
+        (t.name.op, t.name.shard) for t in all_tasks if "const" in t.name.op
+    ))
+
+
+def test_no_memo_collision_between_reduce_and_reshuffle():
+    """Regression: consumers with equal partition counts but different
+    partitioner/combiner configs must not share producer tasks — a
+    Reshuffle reading Reduce's pre-combined producer output would silently
+    merge duplicate keys."""
+    import bigslice_tpu.slicetest as slicetest
+
+    keys = np.array([1, 1, 2, 2] * 5, dtype=np.int32)
+    vals = np.ones(20, dtype=np.int32)
+    s = bs.Const(2, keys, vals)
+    r = bs.Reduce(s, lambda a, b: a + b)
+    p = bs.Reshuffle(s)
+    cg = bs.Cogroup(
+        bs.Map(r, lambda k, v: (k, v)),  # force distinct chains
+        bs.Map(p, lambda k, v: (k, v)),
+    )
+    rows = slicetest.sorted_rows(cg)
+    # Reshuffle side must retain all 10 duplicate rows per key,
+    # Reduce side exactly one combined value.
+    assert [(k, len(a), len(b)) for k, a, b in rows] == [
+        (1, 1, 10), (2, 1, 10)
+    ]
+    assert sorted(rows[0][1]) == [10] and sorted(rows[1][1]) == [10]
+
+
+def test_materialize_breaks_pipeline():
+    s = bs.Const(2, np.arange(4, dtype=np.int32))
+    m = bs.Map(s, lambda x: x + 1)
+    m.pragmas = (bs.Materialize(),)
+    m2 = bs.Map(m, lambda x: x * 2)
+    tasks = compile_mod.Compiler(1).compile(m2)
+    all_tasks = iter_tasks(tasks)
+    assert len(all_tasks) == 4  # two levels of 2 shards
+
+
+def test_golden_trivial():
+    s = bs.Const(2, np.arange(4, dtype=np.int32))
+    m = bs.Map(s, lambda x: x + 1)
+    check_golden("trivial", graph(m))
+
+
+def test_golden_shuffle():
+    s = bs.Const(2, np.arange(4, dtype=np.int32),
+                 np.ones(4, dtype=np.int32))
+    check_golden("shuffle", graph(bs.Reduce(s, lambda a, b: a + b)))
+
+
+def test_golden_branch_shuffle():
+    s = bs.Const(2, np.arange(4, dtype=np.int32),
+                 np.ones(4, dtype=np.int32))
+    a = bs.Reduce(s, lambda x, y: x + y)
+    b = bs.Cogroup(s, a)
+    check_golden("branch-shuffle", graph(b))
+
+
+def test_golden_reshuffle_chain():
+    s = bs.Const(3, np.arange(9, dtype=np.int32))
+    r = bs.Reshuffle(s)
+    m = bs.Map(r, lambda x: x + 1)
+    check_golden("reshuffle-chain", graph(m))

@@ -1,0 +1,194 @@
+"""Evaluator state-machine tests with a stub executor.
+
+Mirrors exec/eval_test.go: a testExecutor that only flips task states lets
+tests drive the DAG state machine directly — lost-task resubmission, error
+propagation, the consecutive-loss cap — plus a randomized-loss stress run
+(exec/evalstress_test.go).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from bigslice_tpu.exec import evaluate as evaluate_mod
+from bigslice_tpu.exec.evaluate import evaluate, MAX_CONSECUTIVE_LOST
+from bigslice_tpu.exec.task import (
+    Partitioner,
+    Task,
+    TaskDep,
+    TaskError,
+    TaskName,
+    TaskState,
+)
+
+
+def make_task(op, shard=0, num_shard=1, deps=()):
+    return Task(
+        name=TaskName(1, op, shard, num_shard),
+        do=lambda factories: iter(()),
+        deps=deps,
+        partitioner=Partitioner(),
+        schema=None,
+    )
+
+
+def chain(n):
+    """t0 <- t1 <- ... <- t(n-1); returns tasks root-last."""
+    tasks = [make_task("t0")]
+    for i in range(1, n):
+        tasks.append(
+            make_task(f"t{i}", deps=[TaskDep((tasks[-1],), 0)])
+        )
+    return tasks
+
+
+class StubExecutor:
+    """Flips submitted tasks to a scripted state (exec/eval_test.go:25-54)."""
+
+    def __init__(self, policy=None):
+        self.policy = policy or (lambda task, attempt: TaskState.OK)
+        self.attempts = {}
+        self.lock = threading.Lock()
+
+    def submit(self, task):
+        def run():
+            with self.lock:
+                n = self.attempts.get(str(task.name), 0)
+                self.attempts[str(task.name)] = n + 1
+            if not task.transition_if(TaskState.WAITING, TaskState.RUNNING):
+                return
+            state = self.policy(task, n)
+            if state == TaskState.OK:
+                task.mark_ok()
+            elif state == TaskState.LOST:
+                task.mark_lost(RuntimeError("stub lost"))
+            else:
+                task.set_state(state, RuntimeError("stub error"))
+
+        threading.Thread(target=run, daemon=True).start()
+
+
+def test_chain_evaluates_in_order():
+    tasks = chain(4)
+    done = []
+    ex = StubExecutor()
+    orig = ex.policy
+
+    def policy(task, attempt):
+        done.append(task.name.op)
+        return orig(task, attempt)
+
+    ex.policy = policy
+    evaluate(ex, [tasks[-1]])
+    assert all(t.state == TaskState.OK for t in tasks)
+    assert done.index("t0") < done.index("t1") < done.index("t3")
+
+
+def test_error_propagates():
+    tasks = chain(3)
+
+    def policy(task, attempt):
+        if task.name.op == "t1":
+            return TaskState.ERR
+        return TaskState.OK
+
+    with pytest.raises(TaskError):
+        evaluate(StubExecutor(policy), [tasks[-1]])
+    assert tasks[1].state == TaskState.ERR
+
+
+def test_lost_task_resubmitted():
+    tasks = chain(2)
+
+    def policy(task, attempt):
+        if task.name.op == "t1" and attempt < 2:
+            return TaskState.LOST
+        return TaskState.OK
+
+    ex = StubExecutor(policy)
+    evaluate(ex, [tasks[-1]])
+    assert ex.attempts["inv1/t1@1:0"] == 3
+    assert tasks[-1].state == TaskState.OK
+
+
+def test_consecutive_lost_cap():
+    tasks = chain(1)
+    ex = StubExecutor(lambda task, attempt: TaskState.LOST)
+    with pytest.raises(TaskError) as ei:
+        evaluate(ex, [tasks[-1]])
+    assert "consecutive" in str(ei.value)
+    assert ex.attempts["inv1/t0@1:0"] == MAX_CONSECUTIVE_LOST
+
+
+def test_lost_dep_reruns_producer():
+    """A task whose dep output vanished marks the dep LOST; the evaluator
+    re-runs the producer then the consumer (exec/eval.go:112-115)."""
+    t0 = make_task("t0")
+    t1 = make_task("t1", deps=[TaskDep((t0,), 0)])
+    state = {"sabotaged": False}
+
+    def policy(task, attempt):
+        if task.name.op == "t1" and not state["sabotaged"]:
+            state["sabotaged"] = True
+            t0.mark_lost(RuntimeError("output vanished"))
+            return TaskState.LOST
+        return TaskState.OK
+
+    ex = StubExecutor(policy)
+    evaluate(ex, [t1])
+    assert ex.attempts["inv1/t0@1:0"] == 2
+    assert ex.attempts["inv1/t1@1:0"] == 2
+    assert t1.state == TaskState.OK
+
+
+def test_concurrent_evaluations_share_tasks():
+    """Two evals over overlapping graphs coordinate via task state
+    (exec/eval.go:126-135)."""
+    shared = chain(3)
+    ex = StubExecutor()
+    errs = []
+
+    def run_eval():
+        try:
+            evaluate(ex, [shared[-1]])
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=run_eval) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errs
+    assert all(t.state == TaskState.OK for t in shared)
+    # Each task ran exactly once despite 4 concurrent evaluations.
+    assert all(n == 1 for n in ex.attempts.values())
+
+
+def test_stress_random_loss():
+    """Randomized task loss must still converge (evalstress_test.go)."""
+    rng = np.random.RandomState(0)
+
+    # Diamond-heavy DAG: layers of tasks each depending on all previous
+    # layer's tasks.
+    layers = [[make_task("l0s%d" % i) for i in range(3)]]
+    for li in range(1, 4):
+        prev = layers[-1]
+        layers.append([
+            make_task(
+                "l%ds%d" % (li, i),
+                deps=[TaskDep(tuple(prev), i % 1)],
+            )
+            for i in range(3)
+        ])
+    roots = layers[-1]
+
+    def policy(task, attempt):
+        # 30% loss, but never more than 3 consecutive (cap is 5).
+        if attempt < 3 and rng.rand() < 0.3:
+            return TaskState.LOST
+        return TaskState.OK
+
+    evaluate(StubExecutor(policy), roots)
+    assert all(t.state == TaskState.OK for l in layers for t in l)

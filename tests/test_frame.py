@@ -1,0 +1,190 @@
+"""Frame semantics tests (mirrors frame/frame_test.go)."""
+
+import numpy as np
+import pytest
+
+from bigslice_tpu import Frame, Schema
+from bigslice_tpu.frame import codec
+from bigslice_tpu.frame import ops as frame_ops
+from bigslice_tpu.slicetype import ColType
+
+
+def test_schema_basics():
+    s = Schema([np.int32, np.float32, str], prefix=2)
+    assert len(s) == 3
+    assert s.prefix == 2
+    assert s[0].is_device and s[2].is_host
+    assert s.key == s.cols[:2]
+    assert s == Schema([np.int32, np.float32, str], prefix=2)
+    assert s != s.with_prefix(1)
+
+
+def test_schema_prefix_range():
+    with pytest.raises(ValueError):
+        Schema([np.int32], prefix=2)
+
+
+def test_frame_construction_and_infer():
+    f = Frame([[1, 2, 3], ["a", "b", "c"]])
+    assert len(f) == 3
+    assert f.schema[0].dtype == np.int32  # int64 coerced to device int32
+    assert f.schema[1].is_host
+    assert f.row(1) == (2, "b")
+
+
+def test_frame_ragged_rejected():
+    with pytest.raises(ValueError):
+        Frame([[1, 2], ["a"]])
+
+
+def test_slice_take_concat():
+    f = Frame([np.arange(10, dtype=np.int32), np.arange(10, dtype=np.float32)])
+    s = f.slice(2, 5)
+    assert len(s) == 3
+    assert s.row(0) == (2, 2.0)
+    t = f.take(np.array([9, 0, 4]))
+    assert [r[0] for r in t.rows()] == [9, 0, 4]
+    c = Frame.concat([s, t])
+    assert len(c) == 6
+    assert c.row(3) == (9, 9.0)
+
+
+def test_from_rows_roundtrip():
+    schema = Schema([np.int32, str], prefix=1)
+    rows = [(1, "x"), (2, "y")]
+    f = Frame.from_rows(rows, schema)
+    assert list(f.rows()) == rows
+
+
+def test_hash_deterministic_and_spread():
+    f = Frame([np.arange(1000, dtype=np.int32)])
+    h1 = np.asarray(f.hash_keys(seed=1))
+    h2 = np.asarray(f.hash_keys(seed=1))
+    np.testing.assert_array_equal(h1, h2)
+    h3 = np.asarray(f.hash_keys(seed=2))
+    assert not np.array_equal(h1, h3)
+    parts = np.asarray(f.partition_ids(8))
+    counts = np.bincount(parts, minlength=8)
+    assert counts.min() > 0  # all partitions hit
+    assert set(np.unique(parts)) <= set(range(8))
+
+
+def test_hash_host_column_stable():
+    f = Frame([np.array(["apple", "banana", "apple"], dtype=object)])
+    h = f.hash_keys()
+    assert h[0] == h[2] != h[1]
+
+
+def test_hash_multicolumn():
+    f = Frame(
+        [np.array([1, 1, 2], np.int32), np.array([1, 2, 1], np.int32)],
+        prefix=2,
+    )
+    h = np.asarray(f.hash_keys())
+    assert len(set(h.tolist())) == 3  # order-dependent combine
+
+
+def test_float_negzero_hash_equal():
+    f = Frame([np.array([0.0, -0.0], np.float32)])
+    h = np.asarray(f.hash_keys())
+    assert h[0] == h[1]
+
+
+def test_sort_indices_device_and_host():
+    f = Frame([np.array([3, 1, 2], np.int32), np.array([0, 1, 2], np.int32)])
+    np.testing.assert_array_equal(f.sort_indices(), [1, 2, 0])
+    g = Frame([np.array(["b", "a", "c"], dtype=object)])
+    np.testing.assert_array_equal(g.sort_indices(), [1, 0, 2])
+
+
+def test_sort_multicolumn_stable():
+    f = Frame(
+        [
+            np.array([1, 2, 1, 2], np.int32),
+            np.array([9, 8, 7, 6], np.int32),
+        ],
+        prefix=2,
+    )
+    out = f.sorted_by_key()
+    assert list(out.rows()) == [(1, 7), (1, 9), (2, 6), (2, 8)]
+
+
+def test_empty_frame():
+    schema = Schema([np.int32, str])
+    f = Frame.empty(schema)
+    assert len(f) == 0
+    assert list(f.rows()) == []
+
+
+def test_jax_columns():
+    import jax.numpy as jnp
+
+    f = Frame([jnp.arange(5, dtype=jnp.int32)])
+    assert len(f) == 5
+    assert f.to_host().row(4) == (4,)
+    h = f.hash_keys()
+    assert h.shape == (5,)
+
+
+class TestCodec:
+    def roundtrip(self, f):
+        data = codec.encode_frame(f)
+        out, pos = codec.decode_frame(data)
+        assert pos == len(data)
+        assert out == f.to_host()
+
+    def test_numeric(self):
+        self.roundtrip(
+            Frame([np.arange(100, dtype=np.int32),
+                   np.linspace(0, 1, 100, dtype=np.float32)], prefix=2)
+        )
+
+    def test_object(self):
+        self.roundtrip(Frame([np.array(["a", "bb", "ccc"], dtype=object)]))
+
+    def test_empty(self):
+        self.roundtrip(Frame.empty(Schema([np.int32])))
+
+    def test_stream(self):
+        frames = [
+            Frame([np.arange(i + 1, dtype=np.int32)]) for i in range(5)
+        ]
+        blob = b"".join(codec.encode_frame(f) for f in frames)
+        out = list(codec.read_frames(blob))
+        assert len(out) == 5
+        assert all(a == b for a, b in zip(out, frames))
+
+    def test_corruption_detected(self):
+        data = bytearray(
+            codec.encode_frame(Frame([np.arange(10, dtype=np.int32)]))
+        )
+        data[len(data) // 2] ^= 0xFF
+        with pytest.raises(codec.CorruptionError):
+            codec.decode_frame(bytes(data))
+
+
+def test_fmix32_mixes():
+    x = np.arange(4, dtype=np.uint32)
+    y = frame_ops.fmix32(x)
+    assert y.dtype == np.uint32
+    assert len(set(y.tolist())) == 4
+
+
+def test_float64_ndarray_downcast_to_device():
+    # Raw 64-bit ndarrays must not smuggle past the device whitelist
+    # (hashing assumes <=4-byte lanes).
+    f = Frame([np.array([1.5, 2.5, 1.5, 3.5]), np.arange(4, dtype=np.int64)])
+    assert f.schema[0].dtype == np.float32
+    assert f.schema[1].dtype == np.int32
+    assert len(f.hash_keys()) == 4
+
+
+def test_codec_preserves_coltype_tag():
+    from bigslice_tpu.slicetype import ColType, Schema as S
+
+    col = np.empty(2, dtype=object)
+    col[:] = ["a", "b"]
+    f = Frame([col], S([ColType(np.dtype(object), "mytag")], 1))
+    out, _ = codec.decode_frame(codec.encode_frame(f))
+    assert out.schema[0].tag == "mytag"
+    assert out == f

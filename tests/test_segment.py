@@ -1,0 +1,86 @@
+"""Device/host keyed-reduction kernel tests (mirrors exec/combiner_test.go
+and sortio/sort_test.go roles)."""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from bigslice_tpu.parallel import segment
+
+
+def _dict_oracle(keys, vals, fn):
+    acc = {}
+    for k, v in zip(keys, vals):
+        acc[k] = fn(acc[k], v) if k in acc else v
+    return acc
+
+
+def test_device_reduce_by_key_sum():
+    rng = np.random.RandomState(0)
+    keys = rng.randint(0, 50, size=1000).astype(np.int32)
+    vals = rng.randint(0, 100, size=1000).astype(np.int32)
+    red = segment.DeviceReduceByKey(lambda a, b: a + b, nkeys=1, nvals=1)
+    (ok,), (ov,) = red([keys], [vals], len(keys))
+    oracle = _dict_oracle(keys.tolist(), vals.tolist(), lambda a, b: a + b)
+    assert len(ok) == len(oracle)
+    np.testing.assert_array_equal(ok, np.sort(np.asarray(list(oracle), np.int32)))
+    for k, v in zip(ok.tolist(), ov.tolist()):
+        assert oracle[k] == v
+
+
+def test_device_reduce_by_key_max_multikey():
+    rng = np.random.RandomState(1)
+    k1 = rng.randint(0, 10, size=500).astype(np.int32)
+    k2 = rng.randint(0, 10, size=500).astype(np.int32)
+    v = rng.rand(500).astype(np.float32)
+    red = segment.DeviceReduceByKey(
+        lambda a, b: jnp.maximum(a, b), nkeys=2, nvals=1
+    )
+    (ok1, ok2), (ov,) = red([k1, k2], [v], 500)
+    oracle = _dict_oracle(
+        list(zip(k1.tolist(), k2.tolist())), v.tolist(), max
+    )
+    assert len(ok1) == len(oracle)
+    for a, b, val in zip(ok1.tolist(), ok2.tolist(), ov.tolist()):
+        assert abs(oracle[(a, b)] - val) < 1e-6
+
+
+def test_device_reduce_ragged_sizes():
+    """Bucket padding must not contaminate results at any size."""
+    red = segment.DeviceReduceByKey(lambda a, b: a + b, nkeys=1, nvals=1)
+    for n in (1, 2, 3, 7, 8, 9, 100):
+        keys = (np.arange(n) % 3).astype(np.int32)
+        vals = np.ones(n, dtype=np.int32)
+        (ok,), (ov,) = red([keys], [vals], n)
+        oracle = _dict_oracle(keys.tolist(), vals.tolist(), lambda a, b: a + b)
+        assert dict(zip(ok.tolist(), ov.tolist())) == oracle
+
+
+def test_device_reduce_multival():
+    keys = np.array([1, 2, 1, 2, 1], np.int32)
+    a = np.array([1, 2, 3, 4, 5], np.int32)
+    b = np.array([10.0, 20.0, 30.0, 40.0, 50.0], np.float32)
+
+    def fn(x, y):
+        return (x[0] + y[0], jnp.minimum(x[1], y[1]))
+
+    red = segment.DeviceReduceByKey(fn, nkeys=1, nvals=2)
+    (ok,), (oa, ob) = red([keys], [a, b], 5)
+    out = dict(zip(ok.tolist(), zip(oa.tolist(), ob.tolist())))
+    assert out == {1: (9, 10.0), 2: (6, 20.0)}
+
+
+def test_host_reduce_by_key():
+    keys = [np.array(["a", "b", "a", "c"], dtype=object)]
+    vals = [np.array([1, 2, 3, 4], np.int32)]
+    ok, ov = segment.host_reduce_by_key(keys, vals, lambda a, b: a + b, 1)
+    assert dict(zip(ok[0].tolist(), ov[0].tolist())) == {
+        "a": 4, "b": 2, "c": 4
+    }
+
+
+def test_canonical_combine_multi():
+    cfn = segment.canonical_combine(lambda a, b: (a[0] + b[0], a[1] * b[1]), 2)
+    assert cfn((1, 2), (3, 4)) == (4, 8)
+    cfn1 = segment.canonical_combine(lambda a, b: a + b, 1)
+    assert cfn1((5,), (6,)) == (11,)

@@ -1,0 +1,329 @@
+"""End-to-end combinator tests through the local executor.
+
+Mirrors the reference's executor-parameterized integration tests
+(slice_test.go:64-66): every combinator runs end-to-end. The executor
+matrix grows as executors land (mesh executor tests live in
+test_meshexec.py).
+"""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import bigslice_tpu as bs
+from bigslice_tpu import slicetest, typecheck
+from bigslice_tpu.exec.session import Session
+
+
+@pytest.fixture
+def sess():
+    return Session()
+
+
+def test_const_roundtrip(sess):
+    s = bs.Const(3, [1, 2, 3, 4, 5, 6, 7], ["a", "b", "c", "d", "e", "f", "g"])
+    rows = slicetest.sorted_rows(s, session=sess)
+    assert rows == [(i + 1, c) for i, c in enumerate("abcdefg")]
+
+
+def test_const_more_shards_than_rows(sess):
+    s = bs.Const(10, [1, 2, 3])
+    assert slicetest.sorted_rows(s, session=sess) == [(1,), (2,), (3,)]
+
+
+def test_map_jax(sess):
+    s = bs.Const(2, np.arange(10, dtype=np.int32))
+    m = bs.Map(s, lambda x: (x * 2, x.astype(jnp.float32) / 2))
+    assert m.mode == "jax"
+    rows = slicetest.sorted_rows(m, session=sess)
+    assert rows == [(2 * i, i / 2) for i in range(10)]
+
+
+def test_map_host(sess):
+    s = bs.Const(2, ["a", "bb", "ccc"])
+    m = bs.Map(s, lambda x: (x, len(x)), out=[str, np.int32])
+    assert m.mode == "host"
+    rows = slicetest.sorted_rows(m, session=sess)
+    assert rows == [("a", 1), ("bb", 2), ("ccc", 3)]
+
+
+def test_map_requires_out_for_host_fn():
+    s = bs.Const(2, ["a", "b"])
+    with pytest.raises(typecheck.TypecheckError):
+        bs.Map(s, lambda x: x.upper())
+
+
+def test_filter_jax(sess):
+    s = bs.Const(3, np.arange(20, dtype=np.int32))
+    f = bs.Filter(s, lambda x: x % 2 == 0)
+    assert f.mode == "jax"
+    rows = slicetest.sorted_rows(f, session=sess)
+    assert rows == [(i,) for i in range(0, 20, 2)]
+
+
+def test_filter_host(sess):
+    s = bs.Const(2, ["apple", "banana", "cherry"])
+    f = bs.Filter(s, lambda x: "an" in x)
+    assert f.mode == "host"
+    assert slicetest.sorted_rows(f, session=sess) == [("banana",)]
+
+
+def test_flatmap(sess):
+    s = bs.Const(2, ["a b", "c d e", ""])
+    fm = bs.Flatmap(s, lambda line: [(w,) for w in line.split()], out=[str])
+    rows = slicetest.sorted_rows(fm, session=sess)
+    assert rows == [("a",), ("b",), ("c",), ("d",), ("e",)]
+
+
+def test_head(sess):
+    s = bs.Const(2, np.arange(100, dtype=np.int32))
+    h = bs.Head(s, 3)
+    rows = slicetest.scan_all(h, session=sess)
+    assert len(rows) == 6  # 3 per shard
+
+
+def test_scan_sink(sess):
+    collected = {}
+
+    def sink(shard, reader):
+        collected[shard] = sum(len(f) for f in reader)
+
+    s = bs.Const(4, np.arange(40, dtype=np.int32))
+    rows = slicetest.scan_all(bs.Scan(s, sink), session=sess)
+    assert rows == []
+    assert sum(collected.values()) == 40
+    assert len(collected) == 4
+
+
+def test_prefixed_unwrap():
+    s = bs.Const(2, [1, 2], [3, 4], [5, 6])
+    p = bs.Prefixed(s, 2)
+    assert p.schema.prefix == 2
+    assert bs.Unwrap(p) is s
+
+
+def test_reduce_jax(sess):
+    keys = np.array([1, 2, 1, 3, 2, 1], dtype=np.int32)
+    vals = np.array([1, 1, 1, 1, 1, 1], dtype=np.int32)
+    r = bs.Reduce(bs.Const(3, keys, vals), lambda a, b: a + b)
+    rows = slicetest.sorted_rows(r, session=sess)
+    assert rows == [(1, 3), (2, 2), (3, 1)]
+
+
+def test_reduce_host_keys(sess):
+    words = ["the", "quick", "the", "fox", "quick", "the"]
+    r = bs.Reduce(
+        bs.Const(3, words, np.ones(len(words), dtype=np.int32)),
+        lambda a, b: a + b,
+    )
+    rows = slicetest.sorted_rows(r, session=sess)
+    assert rows == [("fox", 1), ("quick", 2), ("the", 3)]
+
+
+def test_reduce_large_random(sess):
+    rng = np.random.RandomState(42)
+    keys = rng.randint(0, 1000, size=20_000).astype(np.int32)
+    vals = rng.randint(0, 10, size=20_000).astype(np.int32)
+    r = bs.Reduce(bs.Const(4, keys, vals), lambda a, b: a + b)
+    rows = slicetest.scan_all(r, session=sess)
+    oracle = {}
+    for k, v in zip(keys.tolist(), vals.tolist()):
+        oracle[k] = oracle.get(k, 0) + v
+    assert dict(rows) == oracle
+    assert len(rows) == len(oracle)  # no duplicate keys across shards
+
+
+def test_fold(sess):
+    keys = ["a", "b", "a", "c", "b", "a"]
+    vals = np.array([1, 2, 3, 4, 5, 6], dtype=np.int32)
+    f = bs.Fold(bs.Const(3, keys, vals), lambda acc, v: acc + v, init=0,
+                out_value=np.int32)
+    rows = slicetest.sorted_rows(f, session=sess)
+    assert rows == [("a", 10), ("b", 7), ("c", 4)]
+
+
+def test_fold_nonassociative(sess):
+    # Fold supports non-associative accumulation (list building).
+    keys = np.array([1, 1, 2], dtype=np.int32)
+    vals = np.array([10, 20, 30], dtype=np.int32)
+    f = bs.Fold(
+        bs.Const(2, keys, vals),
+        lambda acc, v: acc + [v],
+        init=list,
+        out_value=object,
+    )
+    rows = slicetest.sorted_rows(f, session=sess)
+    assert [(k, sorted(v)) for k, v in rows] == [(1, [10, 20]), (2, [30])]
+
+
+def test_cogroup_single(sess):
+    keys = ["x", "y", "x"]
+    vals = np.array([1, 2, 3], dtype=np.int32)
+    cg = bs.Cogroup(bs.Const(2, keys, vals))
+    rows = slicetest.sorted_rows(cg, session=sess)
+    assert [(k, sorted(v)) for k, v in rows] == [("x", [1, 3]), ("y", [2])]
+
+
+def test_cogroup_join(sess):
+    left = bs.Const(2, ["a", "b", "a"], np.array([1, 2, 3], np.int32))
+    right = bs.Const(3, ["b", "c"], ["B", "C"])
+    cg = bs.Cogroup(left, right)
+    rows = slicetest.sorted_rows(cg, session=sess)
+    got = [(k, sorted(l), sorted(r)) for k, l, r in rows]
+    assert got == [
+        ("a", [1, 3], []),
+        ("b", [2], ["B"]),
+        ("c", [], ["C"]),
+    ]
+
+
+def test_reshuffle_preserves_rows(sess):
+    keys = np.arange(100, dtype=np.int32)
+    s = bs.Reshuffle(bs.Const(4, keys))
+    rows = slicetest.sorted_rows(s, session=sess)
+    assert rows == [(i,) for i in range(100)]
+
+
+def test_reshuffle_groups_keys_per_shard(sess):
+    # After reshuffle, all rows with equal keys land in the same shard.
+    keys = np.array([1, 2, 3, 1, 2, 3, 1] * 10, dtype=np.int32)
+    s = bs.Reshuffle(bs.Const(5, keys))
+    shard_of = {}
+    res = slicetest.run(s, session=sess)
+    for shard in range(res.num_shards):
+        for f in res.reader(shard, ()):
+            for (k,) in f.rows():
+                shard_of.setdefault(k, set()).add(shard)
+    assert all(len(shards) == 1 for shards in shard_of.values())
+
+
+def test_repartition(sess):
+    def part(frame, nparts):
+        # everything to partition 0
+        return np.zeros(len(frame), dtype=np.int32)
+
+    s = bs.Repartition(bs.Const(4, np.arange(10, dtype=np.int32)), part)
+    res = slicetest.run(s, session=sess)
+    nonempty = [
+        shard
+        for shard in range(res.num_shards)
+        if sum(len(f) for f in res.reader(shard, ())) > 0
+    ]
+    assert nonempty == [0]
+
+
+def test_reshard(sess):
+    s = bs.Const(2, np.arange(10, dtype=np.int32))
+    r = bs.Reshard(s, 5)
+    assert r.num_shards == 5
+    assert slicetest.sorted_rows(r, session=sess) == [(i,) for i in range(10)]
+    assert bs.Reshard(s, 2) is s  # identity
+
+
+def test_readerfunc(sess):
+    def gen(shard):
+        yield ([shard * 10 + 1, shard * 10 + 2],)
+
+    s = bs.ReaderFunc(3, gen, out=[np.int32])
+    rows = slicetest.sorted_rows(s, session=sess)
+    assert rows == [(1,), (2,), (11,), (12,), (21,), (22,)]
+
+
+def test_writerfunc(sess):
+    written = []
+
+    def write(shard, frame):
+        written.extend(frame.rows())
+
+    s = bs.Const(2, np.arange(5, dtype=np.int32))
+    rows = slicetest.sorted_rows(bs.WriterFunc(s, write), session=sess)
+    assert rows == [(i,) for i in range(5)]
+    assert sorted(written) == rows
+
+
+def test_scanreader(tmp_path, sess):
+    p = tmp_path / "lines.txt"
+    p.write_text("one\ntwo\nthree\nfour\n")
+    s = bs.ScanReader(3, str(p))
+    rows = slicetest.sorted_rows(s, session=sess)
+    assert rows == [("four",), ("one",), ("three",), ("two",)]
+
+
+def test_wordcount_end_to_end(sess):
+    """The minimum end-to-end slice from SURVEY.md §7.2(4):
+    ReaderFunc → Flatmap → Reduce word count."""
+    text = ["the quick brown fox", "jumps over the lazy dog",
+            "the fox"]
+
+    def gen(shard):
+        yield ([text[i] for i in range(shard, len(text), 2)],)
+
+    lines = bs.ReaderFunc(2, gen, out=[str])
+    words = bs.Flatmap(lines, lambda l: [(w,) for w in l.split()], out=[str])
+    ones = bs.Map(words, lambda w: (w, 1), out=[str, np.int32])
+    counts = bs.Reduce(ones, lambda a, b: a + b)
+    rows = dict(slicetest.scan_all(counts, session=sess))
+    assert rows == {
+        "the": 3, "quick": 1, "brown": 1, "fox": 2, "jumps": 1,
+        "over": 1, "lazy": 1, "dog": 1,
+    }
+
+
+def test_func_registry_and_run(sess):
+    @bs.func
+    def pipeline(n):
+        return bs.Map(
+            bs.Const(2, np.arange(n, dtype=np.int32)), lambda x: x + 1
+        )
+
+    res = sess.run(pipeline, 5)
+    assert sorted(res.rows()) == [(i + 1,) for i in range(5)]
+
+
+def test_result_reuse(sess):
+    """Results feed later runs without recomputation
+    (exec/compile.go:226-261)."""
+    calls = []
+
+    def gen(shard):
+        calls.append(shard)
+        yield ([shard, shard + 10],)
+
+    src = bs.ReaderFunc(2, gen, out=[np.int32])
+    res1 = sess.run(src)
+    ncalls = len(calls)
+    # Non-shuffle reuse.
+    res2 = sess.run(bs.Map(res1, lambda x: x * 2))
+    assert sorted(res2.rows()) == [(0,), (2,), (20,), (22,)]
+    # Shuffle reuse (adapter tasks).
+    res3 = sess.run(bs.Reduce(
+        bs.Map(res1, lambda x: (x % 2, x)), lambda a, b: a + b))
+    assert len(calls) == ncalls  # source never re-ran
+
+
+def test_pragmas_compose():
+    s = bs.Const(2, [1, 2], schema=None)
+    m = bs.Map(s, lambda x: x + 1)
+    assert m.procs == 1 and not m.exclusive
+
+
+def test_map_jax_out_schema_reconciled(sess):
+    # out= with a different dtype than the traced output must cast, not lie.
+    s = bs.Const(2, np.arange(4, dtype=np.int32))
+    m = bs.Map(s, lambda x: x * 2, out=[np.float32])
+    assert m.mode == "jax"
+    res = slicetest.run(m, session=sess)
+    for f in res.frames():
+        assert f.cols[0].dtype == np.float32
+    assert slicetest.sorted_rows(m, session=sess) == [
+        (0.0,), (2.0,), (4.0,), (6.0,)
+    ]
+
+
+def test_reduce_float64_ndarray_keys(sess):
+    # Regression: float64 ndarray keys crashed partitioning pre-downcast.
+    keys = np.array([1.5, 2.5, 1.5, 3.5])
+    vals = np.ones(4, dtype=np.int32)
+    r = bs.Reduce(bs.Const(2, keys, vals), lambda a, b: a + b)
+    rows = slicetest.sorted_rows(r, session=sess)
+    assert rows == [(1.5, 2), (2.5, 1), (3.5, 1)]
