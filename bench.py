@@ -1,0 +1,98 @@
+"""Benchmark: keyed Reduce throughput on the device vs a CPU baseline.
+
+The BASELINE.md headline metric is rows/sec on a keyed Reduce (config #1/
+#2 shape: map-side combine → hash shuffle → final combine). The reference
+publishes no numbers (BASELINE.md), so the baseline column is measured
+here: a numpy sort+reduceat implementation — a *strong* single-core CPU
+stand-in for bigslice's local executor (which pays per-record reflection
+on top; numpy is deliberately generous to the baseline).
+
+The device path runs the full SPMD pipeline (MeshReduceByKey: on-device
+murmur hash, sort, segmented scan, all_to_all, final combine) on
+however many chips are visible — one program, collectives over ICI.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def cpu_baseline(keys: np.ndarray, vals: np.ndarray) -> float:
+    """rows/sec for numpy sort-based reduce-by-key (single core)."""
+    t0 = time.perf_counter()
+    order = np.argsort(keys, kind="stable")
+    sk = keys[order]
+    sv = vals[order]
+    bounds = np.flatnonzero(np.concatenate([[True], sk[1:] != sk[:-1]]))
+    np.add.reduceat(sv, bounds)
+    dt = time.perf_counter() - t0
+    return len(keys) / dt
+
+
+def device_bench(keys: np.ndarray, vals: np.ndarray, iters: int = 5):
+    """rows/sec for the SPMD mesh reduce (all visible devices)."""
+    import jax
+    from jax.sharding import Mesh
+
+    from bigslice_tpu.parallel import shuffle as shuffle_mod
+
+    devs = jax.devices()
+    n = len(devs)
+    mesh = Mesh(np.array(devs), ("shards",))
+    total = len(keys)
+    per = total // n
+    cap = per
+    key_chunks = [keys[i * per : (i + 1) * per] for i in range(n)]
+    val_chunks = [vals[i * per : (i + 1) * per] for i in range(n)]
+    cols, counts = shuffle_mod.shard_columns(
+        mesh, [key_chunks, val_chunks], [per] * n, cap
+    )
+    red = shuffle_mod.MeshReduceByKey(
+        mesh, nkeys=1, nvals=1, capacity=cap,
+        combine_fn=lambda a, b: a + b,
+    )
+
+    def run_once():
+        k_out, v_out, out_counts, overflow = red(
+            [cols[0]], [cols[1]], counts
+        )
+        jax.block_until_ready(v_out[0])
+        return out_counts, overflow
+
+    out_counts, overflow = run_once()  # compile + warm
+    if int(np.asarray(overflow)) != 0:
+        print("warning: shuffle overflow in bench", file=sys.stderr)
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        run_once()
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+    return (n * per) / best, int(np.asarray(out_counts).sum())
+
+
+def main():
+    n_rows = int(sys.argv[1]) if len(sys.argv) > 1 else 1 << 24  # 16.7M
+    n_keys = 1 << 16
+    rng = np.random.RandomState(42)
+    keys = rng.randint(0, n_keys, n_rows).astype(np.int32)
+    vals = np.ones(n_rows, dtype=np.int32)
+
+    base = cpu_baseline(keys, vals)
+    dev, distinct = device_bench(keys, vals)
+    assert distinct <= n_keys
+
+    print(json.dumps({
+        "metric": "reduce_by_key_rows_per_sec",
+        "value": round(dev, 1),
+        "unit": "rows/sec",
+        "vs_baseline": round(dev / base, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,15 @@
+"""Example pipelines ("models") built on the framework.
+
+Mirrors the reference's example/demo programs (SURVEY.md §2.8): the
+``example/max.go`` Reduce example, the ``cmd/urls`` word-count demo, and
+the iterative-workload pattern (Result reuse, exec/compile.go:226-261)
+shown as k-means — which doubles as the MXU-heavy flagship workload.
+
+Access pipelines as ``models.wordcount.wordcount(...)``,
+``models.kmeans.kmeans(...)`` etc. — function names intentionally are not
+re-exported at package level to avoid shadowing the submodules.
+"""
+
+from bigslice_tpu.models import kmeans, maxint, wordcount
+
+__all__ = ["kmeans", "maxint", "wordcount"]

@@ -1,0 +1,137 @@
+"""k-means — the iterative-workload pattern, TPU-first.
+
+The reference expresses iteration as repeated ``sess.Run`` calls feeding
+``Result``s back as Func args (SURVEY.md §3.5). The per-iteration compute
+here is the flagship device workload: the assignment step is one big
+matmul (points × centroidsᵀ) on the MXU, and the update step is a
+one-hot matmul reduction — both fused by XLA into a single program, with
+cross-device aggregation as ``psum`` over the mesh (the "combiner →
+psum/reduce-scatter" lowering from BASELINE.json's north star).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def kmeans_step(points, centroids):
+    """One k-means iteration on one device (jittable).
+
+    points: f32[n, d]; centroids: f32[k, d] → new centroids f32[k, d].
+    Distance ranking via the ‖x−c‖² = ‖x‖² − 2x·c + ‖c‖² expansion: the
+    x·cᵀ term is an [n,d]×[d,k] matmul (MXU); ‖x‖² is rank-invariant and
+    dropped.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    dots = points @ centroids.T  # [n, k] — the MXU hot loop
+    c2 = jnp.sum(centroids * centroids, axis=1)  # [k]
+    assign = jnp.argmin(c2[None, :] - 2.0 * dots, axis=1)  # [n]
+    onehot = jax.nn.one_hot(assign, centroids.shape[0],
+                            dtype=points.dtype)  # [n, k]
+    sums = onehot.T @ points  # [k, d] — second MXU matmul
+    counts = jnp.sum(onehot, axis=0)  # [k]
+    return sums / jnp.maximum(counts, 1.0)[:, None]
+
+
+def mesh_kmeans_step(mesh, k: int, d: int):
+    """Build the SPMD k-means step over a device mesh: points are
+    data-parallel sharded on the mesh axis; centroid sums/counts aggregate
+    with ``psum`` over ICI. Returns a jitted fn
+    ``(points_global, centroids) -> centroids``.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from bigslice_tpu.parallel.meshutil import get_shard_map, mesh_axis
+
+    axis = mesh_axis(mesh)
+    shard_map = get_shard_map()
+
+    def step(points, centroids):
+        dots = points @ centroids.T
+        c2 = jnp.sum(centroids * centroids, axis=1)
+        assign = jnp.argmin(c2[None, :] - 2.0 * dots, axis=1)
+        onehot = jax.nn.one_hot(assign, k, dtype=points.dtype)
+        sums = lax.psum(onehot.T @ points, axis)
+        counts = lax.psum(jnp.sum(onehot, axis=0), axis)
+        return sums / jnp.maximum(counts, 1.0)[:, None]
+
+    return jax.jit(
+        shard_map(
+            step, mesh=mesh,
+            in_specs=(P(axis), P()),
+            out_specs=P(),
+        )
+    )
+
+
+def kmeans(sess, points: np.ndarray, k: int, iters: int = 10,
+           num_shards: int = 4, seed: int = 0):
+    """k-means through the slice API: demonstrates the iterative session
+    pattern (repeated runs over a reused Result, exec/compile.go:226-261).
+
+    Points ride as ``d`` float32 columns; each iteration Maps every point
+    to its nearest centroid id and Reduces per-centroid sums/counts.
+    """
+    import bigslice_tpu as bs
+
+    n, d = points.shape
+    rng = np.random.RandomState(seed)
+    centroids = points[rng.choice(n, size=k, replace=False)].copy()
+
+    cols = [points[:, j].astype(np.float32) for j in range(d)]
+    base = sess.run(bs.Const(num_shards, *cols))  # materialized once
+
+    for _ in range(iters):
+        # _assign_row/_sum_combine are module-level, and centroids ride as
+        # an unbatched Map arg (data, not a trace constant): every
+        # iteration reuses the same compiled assignment and reduce
+        # kernels instead of recompiling per round.
+        assigned = bs.Map(
+            base, _assign_row,
+            out=[np.int32] + [np.float32] * d + [np.float32],
+            args=(centroids,),
+        )
+        summed = bs.Reduce(assigned, _sum_combine)
+        rows = sess.run(summed).rows()
+        for row in rows:
+            cid, vec, cnt = row[0], row[1 : 1 + d], row[-1]
+            if cnt > 0:
+                centroids[cid] = np.asarray(vec, np.float32) / cnt
+    return centroids
+
+
+def _assign_row(*xs_and_c):
+    """Per-row nearest-centroid assignment; last arg is the unbatched
+    [k, d] centroid matrix."""
+    import jax.numpy as jnp
+
+    xs, c = xs_and_c[:-1], xs_and_c[-1]
+    x = jnp.stack(xs)
+    d2 = jnp.sum((c - x[None, :]) ** 2, axis=1)
+    return (jnp.argmin(d2).astype(jnp.int32),) + tuple(xs) + (
+        jnp.float32(1.0),
+    )
+
+
+def _sum_combine(a, b):
+    return tuple(x + y for x, y in zip(a, b))
+
+
+def kmeans_oracle(points: np.ndarray, k: int, iters: int, seed: int = 0):
+    """Reference numpy implementation for tests."""
+    n, d = points.shape
+    rng = np.random.RandomState(seed)
+    centroids = points[rng.choice(n, size=k, replace=False)].copy()
+    for _ in range(iters):
+        d2 = ((points[:, None, :] - centroids[None, :, :]) ** 2).sum(-1)
+        assign = d2.argmin(1)
+        for c in range(k):
+            m = assign == c
+            if m.any():
+                centroids[c] = points[m].mean(0)
+    return centroids

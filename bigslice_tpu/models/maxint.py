@@ -1,0 +1,21 @@
+"""Per-key integer max — the reference's canonical Reduce example
+(example/max.go:14)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import bigslice_tpu as bs
+
+
+def int_max(slice_: bs.Slice) -> bs.Slice:
+    """Max value per key over a (key, value) slice, via Reduce with
+    map-side combining (the jnp.maximum combine runs on device)."""
+    import jax.numpy as jnp
+
+    return bs.Reduce(slice_, lambda a, b: jnp.maximum(a, b))
+
+
+@bs.func
+def int_max_func(nshards: int, keys, vals) -> bs.Slice:
+    return int_max(bs.Const(nshards, keys, vals))

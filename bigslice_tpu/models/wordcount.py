@@ -1,0 +1,39 @@
+"""Word count — the reference's cmd/urls demo shape (cmd/urls/urls.go:37):
+source → tokenize → Map to (word, 1) → Reduce-by-key."""
+
+from __future__ import annotations
+
+from typing import Callable, Union
+
+import numpy as np
+
+import bigslice_tpu as bs
+
+
+def wordcount(num_shards: int, source: Union[str, Callable]) -> bs.Slice:
+    """Count words from a text file path or a callable yielding lines.
+
+    The tokenize/pair stages are host-tier (strings); the count combine
+    is trivially associative so map-side combining kicks in before the
+    shuffle.
+    """
+    lines = bs.ScanReader(num_shards, source)
+    words = bs.Flatmap(
+        lines, lambda line: [(w,) for w in line.split()], out=[str]
+    )
+    pairs = bs.Map(words, lambda w: (w, 1), out=[str, np.int32])
+    return bs.Reduce(pairs, lambda a, b: a + b)
+
+
+def wordcount_ids(num_shards: int, token_ids, bound: int) -> bs.Slice:
+    """Device-tier variant: counts over pre-tokenized int32 ids — the
+    whole combine path (hash, sort, segmented scan) runs on device.
+    ``bound`` is unused except documentation of the id range."""
+    ones = np.ones(len(token_ids), dtype=np.int32)
+    pairs = bs.Const(num_shards, np.asarray(token_ids, np.int32), ones)
+    return bs.Reduce(pairs, lambda a, b: a + b)
+
+
+@bs.func
+def wordcount_func(num_shards: int, path: str) -> bs.Slice:
+    return wordcount(num_shards, path)

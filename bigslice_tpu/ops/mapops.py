@@ -31,7 +31,7 @@ from bigslice_tpu.ops.base import (
     make_name,
     single_dep,
 )
-from bigslice_tpu.parallel.jitutil import PaddedVmap
+from bigslice_tpu.parallel.jitutil import get_padded_vmap
 
 
 def _as_schema(out, default_prefix: int = 1) -> Schema:
@@ -41,16 +41,20 @@ def _as_schema(out, default_prefix: int = 1) -> Schema:
     return Schema(cols, prefix=min(default_prefix, len(cols)))
 
 
-def _try_trace(fn: Callable, in_schema: Schema):
+def _try_trace(fn: Callable, in_schema: Schema, extra: tuple = ()):
     """Attempt an abstract trace of fn over scalar avals of the input
-    columns. Returns the output Schema or None if fn is not traceable."""
+    columns (plus unbatched ``extra`` args). Returns the output Schema or
+    None if fn is not traceable."""
     if not all(ct.is_device for ct in in_schema):
         return None
     try:
         import jax
+        import jax.numpy as jnp
 
         specs = [jax.ShapeDtypeStruct((), ct.dtype) for ct in in_schema]
-        out = jax.eval_shape(fn, *specs)
+        especs = [jax.ShapeDtypeStruct(jnp.shape(e), jnp.asarray(e).dtype)
+                  for e in extra]
+        out = jax.eval_shape(fn, *(specs + especs))
         if not isinstance(out, (tuple, list)):
             out = (out,)
         cols = []
@@ -78,17 +82,23 @@ class _Pipelined(Slice):
 class Map(_Pipelined):
     """Per-record transform (mirrors bigslice.Map, slice.go:566-638).
 
-    ``fn(*row) -> value | tuple``. Traceable fns run vmapped+jitted on
-    device; host fns require ``out=`` (a Schema or list of column types).
+    ``fn(*row, *args) -> value | tuple``. Traceable fns run vmapped+jitted
+    on device; host fns require ``out=`` (a Schema or list of column
+    types). ``args`` are passed unbatched as trailing arguments — dynamic
+    data rather than trace constants, so iterative drivers can rebuild
+    the Map with fresh args each round without recompiling (jit caches
+    are shared per function object).
     """
 
-    def __init__(self, slice_: Slice, fn: Callable, out=None, mode="auto"):
+    def __init__(self, slice_: Slice, fn: Callable, out=None, mode="auto",
+                 args: tuple = ()):
         name = make_name("map")
         self.fn = fn
         self.mode = mode
+        self.args = tuple(args)
         traced = None
         if mode in ("auto", "jax"):
-            traced = _try_trace(fn, slice_.schema)
+            traced = _try_trace(fn, slice_.schema, self.args)
         if traced is not None:
             self.mode = "jax"
             if out is None:
@@ -124,7 +134,7 @@ class Map(_Pipelined):
                             for v, dt in zip(o, _dts)
                         )
 
-            self._vfn = PaddedVmap(fn)
+            self._vfn = get_padded_vmap(fn)
         else:
             if mode == "jax":
                 raise typecheck.errorf(
@@ -145,10 +155,10 @@ class Map(_Pipelined):
                 if not len(f):
                     continue
                 if self.mode == "jax":
-                    cols, n = self._vfn(f.cols, len(f))
+                    cols, n = self._vfn(f.cols, len(f), extra=self.args)
                     yield Frame(cols, self.schema)
                 else:
-                    rows = [self.fn(*r) for r in f.rows()]
+                    rows = [self.fn(*r, *self.args) for r in f.rows()]
                     rows = [
                         r if isinstance(r, tuple) else (r,) for r in rows
                     ]
@@ -172,7 +182,7 @@ class Filter(_Pipelined):
                     "filter: predicate must return bool, got %s", traced
                 )
             self.mode = "jax"
-            self._vfn = PaddedVmap(pred)
+            self._vfn = get_padded_vmap(pred)
         else:
             if mode == "jax":
                 raise typecheck.errorf("filter: predicate not jax-traceable")

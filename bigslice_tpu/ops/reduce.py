@@ -61,7 +61,7 @@ class FrameCombiner:
                         "reduce: slice must have at least one value column")
         self.device = _vals_traceable(fn, schema)
         self._kernel = (
-            segment.DeviceReduceByKey(fn, self.nkeys, self.nvals)
+            segment.cached_reduce_kernel(fn, self.nkeys, self.nvals)
             if self.device
             else None
         )

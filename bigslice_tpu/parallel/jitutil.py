@@ -48,23 +48,61 @@ def pad_cols(cols: Sequence, n: int, target: int) -> list:
 
 
 class PaddedVmap:
-    """vmap+jit a per-row function, amortized over bucketed batch sizes."""
+    """vmap+jit a per-row function, amortized over bucketed batch sizes.
 
-    def __init__(self, fn: Callable, out_tuple: bool = True):
-        import jax
+    ``extra`` arguments are passed unbatched (in_axes=None) — dynamic
+    data, not trace constants, so callers can vary them per call (e.g.
+    k-means centroids per iteration) without recompiling.
+    """
 
+    def __init__(self, fn: Callable):
         self.fn = fn
-        self.out_tuple = out_tuple
-        self._jitted = jax.jit(jax.vmap(fn))
+        self._jitted = {}  # (ncols, nextra) -> jitted vmapped fn
 
-    def __call__(self, cols: Sequence, n: int) -> Tuple[list, int]:
+    def _get(self, ncols: int, nextra: int):
+        key = (ncols, nextra)
+        j = self._jitted.get(key)
+        if j is None:
+            import jax
+
+            j = jax.jit(jax.vmap(
+                self.fn, in_axes=(0,) * ncols + (None,) * nextra
+            ))
+            self._jitted[key] = j
+        return j
+
+    def __call__(self, cols: Sequence, n: int,
+                 extra: Sequence = ()) -> Tuple[list, int]:
         """Apply to n valid rows of equal-length columns; returns (out
         columns sliced to n, n)."""
         target = bucket_size(n)
         padded = pad_cols(cols, n, target)
-        out = self._jitted(*padded)
+        out = self._get(len(cols), len(extra))(*padded, *extra)
         if not isinstance(out, (tuple, list)):
             out = (out,)
         # Slice on the host: an eager device slice would compile one XLA
         # program per distinct n.
         return [np.asarray(o)[:n] for o in out], n
+
+
+_VMAP_CACHE: dict = {}
+
+
+def get_padded_vmap(fn: Callable) -> PaddedVmap:
+    """Share PaddedVmap instances (and their jit caches) across slices
+    built from the same function object — re-constructing a Map with the
+    same fn in a loop compiles once, not once per construction."""
+    import weakref
+
+    key = id(fn)
+    entry = _VMAP_CACHE.get(key)
+    if entry is not None:
+        ref, pv = entry
+        if ref() is fn:
+            return pv
+    pv = PaddedVmap(fn)
+    try:
+        _VMAP_CACHE[key] = (weakref.ref(fn), pv)
+    except TypeError:  # unweakrefable callables: no caching
+        pass
+    return pv

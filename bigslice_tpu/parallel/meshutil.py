@@ -1,0 +1,23 @@
+"""Small shared mesh helpers."""
+
+from __future__ import annotations
+
+
+def mesh_axis(mesh) -> str:
+    """The (single) shard axis name of a framework mesh."""
+    return mesh.axis_names[0]
+
+
+def get_shard_map():
+    """shard_map with the pre-0.9 keyword surface (check_rep) adapted."""
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        def wrap(f, mesh, in_specs, out_specs, check_rep=False):
+            return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_vma=check_rep)
+
+        return wrap
+    from jax.experimental.shard_map import shard_map  # pragma: no cover
+
+    return shard_map

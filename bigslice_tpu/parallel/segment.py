@@ -41,6 +41,58 @@ def canonical_combine(fn: Callable, nvals: int) -> Callable:
     return cfn
 
 
+def make_segmented_reduce(nkeys: int, nvals: int, cfn):
+    """The shared traceable core: sort rows by (validity, keys), find
+    segment boundaries, apply ``cfn`` per segment via a segmented
+    associative scan, and compact survivors to the front.
+
+    Returns ``core(n, key_cols, val_cols) -> (count, keys, vals)`` where
+    inputs are equal-length device columns, ``n`` is the valid-row count,
+    and outputs have one front-compacted row per distinct valid key
+    (sorted by key). Used by both the single-device combiner
+    (DeviceReduceByKey) and the mesh reduce (shuffle.MeshReduceByKey).
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    def core(n, key_cols, val_cols):
+        size = key_cols[0].shape[0]
+        invalid = (jnp.arange(size, dtype=np.int32) >= n).astype(np.int32)
+        ops = (invalid,) + tuple(key_cols) + tuple(val_cols)
+        s = lax.sort(ops, num_keys=1 + nkeys, is_stable=True)
+        s_invalid = s[0]
+        s_keys = s[1 : 1 + nkeys]
+        s_vals = s[1 + nkeys :]
+
+        # Segment starts: row 0, any key change, validity change; padded
+        # rows each form their own segment so they can't contaminate
+        # real reductions.
+        diff = jnp.zeros(size, dtype=bool).at[0].set(True)
+        for k in (s_invalid,) + tuple(s_keys):
+            diff = diff.at[1:].set(diff[1:] | (k[1:] != k[:-1]))
+        diff = diff | (s_invalid == 1)
+
+        def scan_op(x, y):
+            fx, vx = x
+            fy, vy = y
+            merged = cfn(vx, vy)
+            return (fx | fy, tuple(
+                jnp.where(fy, b, m) for b, m in zip(vy, merged)
+            ))
+
+        _, red = lax.associative_scan(scan_op, (diff, tuple(s_vals)))
+        is_last = jnp.ones(size, dtype=bool).at[:-1].set(diff[1:])
+        keep = is_last & (s_invalid == 0)
+        drop = (~keep).astype(np.int32)
+        packed = lax.sort((drop,) + tuple(s_keys) + tuple(red),
+                          num_keys=1, is_stable=True)
+        return (keep.sum().astype(np.int32),
+                tuple(packed[1 : 1 + nkeys]),
+                tuple(packed[1 + nkeys :]))
+
+    return core
+
+
 class DeviceReduceByKey:
     """Jitted keyed reduction over device columns.
 
@@ -52,46 +104,14 @@ class DeviceReduceByKey:
 
     def __init__(self, fn: Callable, nkeys: int, nvals: int):
         import jax
-        import jax.numpy as jnp
-        from jax import lax
 
         cfn = canonical_combine(fn, nvals)
         self.nkeys = nkeys
         self.nvals = nvals
+        core = make_segmented_reduce(nkeys, nvals, cfn)
 
         def kernel(n, *cols):
-            keys = cols[:nkeys]
-            vals = cols[nkeys:]
-            size = cols[0].shape[0]
-            invalid = (jnp.arange(size, dtype=np.int32) >= n).astype(np.int32)
-            operands = (invalid,) + tuple(keys) + tuple(vals)
-            sorted_ops = lax.sort(operands, num_keys=1 + nkeys,
-                                  is_stable=True)
-            s_invalid = sorted_ops[0]
-            s_keys = sorted_ops[1 : 1 + nkeys]
-            s_vals = sorted_ops[1 + nkeys :]
-
-            # Segment starts: row 0, any key column change, validity change.
-            diff = jnp.zeros(size, dtype=bool).at[0].set(True)
-            for k in (s_invalid,) + tuple(s_keys):
-                diff = diff.at[1:].set(diff[1:] | (k[1:] != k[:-1]))
-            # Padded rows each form their own segment so they can't
-            # contaminate real reductions.
-            diff = diff | (s_invalid == 1)
-
-            def scan_op(x, y):
-                fx, vx = x
-                fy, vy = y
-                merged = cfn(vx, vy)
-                out = tuple(
-                    jnp.where(fy, b, m) for b, m in zip(vy, merged)
-                )
-                return (fx | fy, out)
-
-            _, red_vals = lax.associative_scan(scan_op, (diff, tuple(s_vals)))
-            is_last = jnp.ones(size, dtype=bool).at[:-1].set(diff[1:])
-            out_valid = is_last & (s_invalid == 0)
-            return s_keys, red_vals, out_valid
+            return core(n, cols[:nkeys], cols[nkeys:])
 
         self._jitted = jax.jit(kernel)
 
@@ -100,12 +120,31 @@ class DeviceReduceByKey:
 
         size = bucket_size(n)
         cols = pad_cols(list(key_cols) + list(val_cols), n, size)
-        keys, vals, valid = self._jitted(jnp.int32(n), *cols)
-        idx = np.flatnonzero(np.asarray(valid))
+        count, keys, vals = self._jitted(jnp.int32(n), *cols)
+        count = int(count)
         return (
-            [np.asarray(k)[idx] for k in keys],
-            [np.asarray(v)[idx] for v in vals],
+            [np.asarray(k)[:count] for k in keys],
+            [np.asarray(v)[:count] for v in vals],
         )
+
+
+_KERNEL_CACHE: dict = {}
+
+
+def cached_reduce_kernel(fn: Callable, nkeys: int, nvals: int
+                         ) -> DeviceReduceByKey:
+    """Share DeviceReduceByKey instances (and their jit caches) across
+    combiners built from the same function object — iterative sessions
+    re-running the same Reduce then compile once, not once per run."""
+    key = (id(fn), nkeys, nvals)
+    kern = _KERNEL_CACHE.get(key)
+    if kern is None or kern._fn_ref() is not fn:
+        kern = DeviceReduceByKey(fn, nkeys, nvals)
+        import weakref
+
+        kern._fn_ref = weakref.ref(fn)
+        _KERNEL_CACHE[key] = kern
+    return kern
 
 
 def host_reduce_by_key(key_cols, val_cols, fn, nvals: int):

@@ -1,0 +1,304 @@
+"""SPMD shuffle: hash-bucket + all_to_all over a device mesh.
+
+This is the TPU-native replacement for the reference's shuffle — gob
+streams pulled worker→worker over TCP with randomized read order
+(exec/bigmachine.go:818-908, SURVEY.md §5.8) — re-expressed as XLA
+collectives over ICI:
+
+1. each device hashes its rows' key prefixes (murmur-style mix, fused),
+2. rows are sorted by destination shard and scattered into fixed-capacity
+   per-destination buckets (static shapes — XLA requirement, SURVEY.md
+   §7.3(1)),
+3. one ``all_to_all`` moves the buckets; a second tiny ``all_to_all``
+   carries the per-destination row counts,
+4. receivers compact their buckets into a (rows, count) pair.
+
+Everything runs inside one ``shard_map``-decorated jitted program: the
+whole shuffle is a single XLA computation per phase, with the collective
+riding ICI. Skew beyond the static bucket capacity is detected on device
+and surfaced as an overflow count (the caller retries with a larger
+capacity — the recompile-averse bucketing strategy).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from bigslice_tpu.parallel.meshutil import get_shard_map, mesh_axis
+
+
+def send_capacity(capacity: int, nshards: int, slack: float = 2.0) -> int:
+    """Per-(source,dest) bucket rows. A uniform hash sends ~capacity/nshards
+    rows to each destination; ``slack`` is the skew headroom before the
+    overflow signal fires. The receive buffer is nshards*send_cap ≈
+    slack × capacity."""
+    return max(1, int(np.ceil(capacity * slack / nshards)))
+
+
+def make_shuffle_fn(nshards: int, nkeys: int, capacity: int,
+                    axis: str = "shards", seed: int = 0,
+                    partition_fn: Optional[Callable] = None,
+                    slack: float = 2.0):
+    """Build the per-device shuffle body (to be wrapped in shard_map).
+
+    Operates on ``cols`` (each shape [capacity]) plus a valid-row count
+    ``n``. Returns (out_count, overflow, out_cols) where out_cols have
+    ``nshards * send_capacity(...)`` rows, valid rows compacted to the
+    front.
+
+    ``partition_fn(*key_cols) -> int32 ids`` (vectorized, one positional
+    arg per key column) overrides hash partitioning (Repartition
+    support). Ids outside [0, nshards) are dropped and counted into the
+    overflow signal — same observability as the host executor's range
+    check (exec/local.py partition_frame).
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    from bigslice_tpu.frame import ops as frame_ops
+
+    send_cap = send_capacity(capacity, nshards, slack)
+
+    def body(n, *cols):
+        size = cols[0].shape[0]
+        keys = cols[:nkeys]
+        valid = jnp.arange(size, dtype=np.int32) < n
+        if partition_fn is not None:
+            part = jnp.asarray(partition_fn(*keys)).astype(np.int32)
+            # Out-of-range ids route to the drop lane and are counted in
+            # the overflow signal rather than silently clipped.
+            bad = (part < 0) | (part >= nshards)
+            part = jnp.where(bad, np.int32(nshards), part)
+        else:
+            bad = None
+            h = None
+            for k in keys:
+                kh = frame_ops.hash_device_column(k, seed)
+                h = kh if h is None else frame_ops.combine_hashes(h, kh)
+            part = (h % np.uint32(nshards)).astype(np.int32)
+        # Invalid rows route to a virtual shard that sorts last.
+        part = jnp.where(valid, part, np.int32(nshards))
+        n_bad = (
+            jnp.int32(0) if bad is None
+            else (bad & valid).sum().astype(np.int32)
+        )
+
+        # Sort rows by destination; payload rides along.
+        sorted_ops = lax.sort((part,) + tuple(cols), num_keys=1,
+                              is_stable=True)
+        s_part = sorted_ops[0]
+        s_cols = sorted_ops[1:]
+
+        # Row counts per destination and bucket-local offsets.
+        counts = jnp.bincount(s_part, length=nshards + 1)[:nshards]
+        starts = jnp.concatenate(
+            [jnp.zeros(1, np.int32),
+             jnp.cumsum(counts).astype(np.int32)[:-1]]
+        )
+        offset = jnp.arange(size, dtype=np.int32) - jnp.take(
+            starts, jnp.minimum(s_part, nshards - 1)
+        )
+        overflow = jnp.maximum(counts.max() - send_cap, 0) + n_bad
+
+        # Scatter into (nshards, send_cap) send buckets; rows beyond
+        # capacity (or invalid) drop — reported via `overflow`.
+        in_bounds = (offset < send_cap) & (s_part < nshards)
+        dest_row = jnp.where(in_bounds, s_part, nshards)  # drop lane
+        dest_off = jnp.where(in_bounds, offset, 0)
+        out_buckets = []
+        for c in s_cols:
+            buf = jnp.zeros((nshards + 1, send_cap) + c.shape[1:], c.dtype)
+            buf = buf.at[dest_row, dest_off].set(c, mode="drop")
+            out_buckets.append(buf[:nshards])
+        send_counts = jnp.minimum(counts, send_cap).astype(np.int32)
+
+        # The collectives: counts then data, one all_to_all each.
+        recv_counts = lax.all_to_all(
+            send_counts.reshape(nshards, 1), axis, 0, 0, tiled=False
+        ).reshape(nshards)
+        recv = [
+            lax.all_to_all(b, axis, 0, 0, tiled=False)
+            for b in out_buckets
+        ]
+        # recv[i]: (nshards, send_cap) — bucket from each source shard.
+        out_cols = [r.reshape((nshards * send_cap,) + r.shape[2:])
+                    for r in recv]
+        # Validity: row j of source bucket s is valid iff j < recv_counts[s].
+        row_in_bucket = jnp.arange(send_cap, dtype=np.int32)
+        valid_mask = (row_in_bucket[None, :]
+                      < recv_counts[:, None]).reshape(-1)
+        # Compact valid rows to the front (sort by ~valid, stable).
+        inv = (~valid_mask).astype(np.int32)
+        packed = lax.sort((inv,) + tuple(out_cols), num_keys=1,
+                          is_stable=True)
+        out_cols = list(packed[1:])
+        out_count = recv_counts.sum().astype(np.int32)
+        total_overflow = lax.psum(overflow, axis)
+        return out_count, total_overflow, out_cols
+
+    return body
+
+
+class MeshShuffle:
+    """A compiled SPMD shuffle over a mesh (one jitted program).
+
+    ``__call__(sharded_cols, counts)`` where each column is a global array
+    of shape [nshards * capacity, ...] sharded on axis 0, and ``counts``
+    is an int32[nshards] of valid rows per shard. Returns
+    (out_cols, out_counts, overflow_total).
+    """
+
+    def __init__(self, mesh, ncols: int, nkeys: int, capacity: int,
+                 seed: int = 0, partition_fn=None, slack: float = 2.0):
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        shard_map = get_shard_map()
+        axis = mesh_axis(mesh)
+        nshards = mesh.devices.size
+        self.mesh = mesh
+        self.nshards = nshards
+        self.capacity = capacity
+        # Received rows per device: nshards buckets of send_cap rows.
+        self.out_capacity = nshards * send_capacity(capacity, nshards, slack)
+        body = make_shuffle_fn(nshards, nkeys, capacity, axis,
+                               seed, partition_fn, slack)
+
+        col_spec = P(axis)
+        in_specs = (P(axis),) + tuple(col_spec for _ in range(ncols))
+        out_specs = (P(axis), P(), tuple(col_spec for _ in range(ncols)))
+
+        def stepped(counts, *cols):
+            # Per-device view: counts is int32[1], cols are [capacity,...]
+            n = counts[0]
+            out_count, overflow, out_cols = body(n, *cols)
+            return (out_count.reshape(1), overflow, tuple(out_cols))
+
+        self._jitted = jax.jit(
+            shard_map(stepped, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=False)
+        )
+
+    def __call__(self, cols: Sequence, counts):
+        out_counts, overflow, out_cols = self._jitted(counts, *cols)
+        return list(out_cols), out_counts, overflow
+
+
+class MeshReduceByKey:
+    """Mesh-wide keyed reduction: local combine → all_to_all shuffle →
+    final combine, as one jitted SPMD program.
+
+    The end-to-end TPU lowering of Reduce (SURVEY.md §7.1): map-side
+    combining (exec/bigmachine.go:1084-1210) becomes an on-device
+    sort+segmented-scan; the TCP shuffle becomes all_to_all over ICI; the
+    reduce-side merge becomes a second segmented scan.
+    """
+
+    def __init__(self, mesh, nkeys: int, nvals: int, capacity: int,
+                 combine_fn: Callable, seed: int = 0, slack: float = 2.0):
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        from bigslice_tpu.parallel import segment
+
+        shard_map = get_shard_map()
+        axis = mesh_axis(mesh)
+        nshards = mesh.devices.size
+        self.mesh = mesh
+        self.nshards = nshards
+        self.capacity = capacity
+        self.out_capacity = nshards * send_capacity(capacity, nshards, slack)
+        ncols = nkeys + nvals
+        cfn = segment.canonical_combine(combine_fn, nvals)
+        shuffle_body = make_shuffle_fn(nshards, nkeys, capacity,
+                                       axis, seed, slack=slack)
+        # Shared segmented-reduce core (same kernel as the single-device
+        # combiner, parallel/segment.py).
+        combine_local = segment.make_segmented_reduce(nkeys, nvals, cfn)
+
+        def stepped(counts, *cols):
+            n = counts[0]
+            key_cols = cols[:nkeys]
+            val_cols = cols[nkeys:]
+            # 1. map-side combine
+            n1, k1, v1 = combine_local(n, key_cols, val_cols)
+            # 2. shuffle by key hash
+            n2, overflow, out_cols = shuffle_body(n1, *(tuple(k1) + tuple(v1)))
+            k2 = tuple(out_cols[:nkeys])
+            v2 = tuple(out_cols[nkeys:])
+            # 3. reduce-side combine
+            n3, k3, v3 = combine_local(n2, k2, v2)
+            return (n3.reshape(1), overflow,
+                    tuple(k3) + tuple(v3))
+
+        col_spec = P(axis)
+        in_specs = (P(axis),) + tuple(col_spec for _ in range(ncols))
+        out_specs = (P(axis), P(), tuple(col_spec for _ in range(ncols)))
+        self._jitted = jax.jit(
+            shard_map(stepped, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=False)
+        )
+
+    def __call__(self, key_cols: Sequence, val_cols: Sequence, counts):
+        """All columns globally shaped [nshards*capacity,...], sharded on
+        axis 0; counts int32[nshards]. Returns (key_cols, val_cols,
+        out_counts, overflow)."""
+        nkeys = len(key_cols)
+        out_counts, overflow, cols = self._jitted(
+            counts, *(list(key_cols) + list(val_cols))
+        )
+        return (list(cols[:nkeys]), list(cols[nkeys:]), out_counts,
+                overflow)
+
+
+def shard_columns(mesh, cols: Sequence[np.ndarray], counts: Sequence[int],
+                  capacity: int):
+    """Place per-shard host column chunks onto the mesh as global padded
+    arrays: chunk i → device i, padded to `capacity` rows.
+
+    Returns (global_cols, global_counts) ready for MeshShuffle /
+    MeshReduceByKey.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    axis = mesh_axis(mesh)
+    nshards = mesh.devices.size
+    out = []
+    for per_shard in cols:
+        assert len(per_shard) == nshards
+        padded = []
+        for chunk in per_shard:
+            chunk = np.asarray(chunk)
+            if len(chunk) > capacity:
+                raise ValueError(
+                    f"shard chunk of {len(chunk)} rows exceeds capacity "
+                    f"{capacity}"
+                )
+            pad = np.zeros((capacity - len(chunk),) + chunk.shape[1:],
+                           chunk.dtype)
+            padded.append(np.concatenate([chunk, pad]))
+        glob = np.concatenate(padded)
+        out.append(jax.device_put(glob, NamedSharding(mesh, P(axis))))
+    counts_arr = jax.device_put(
+        np.asarray(counts, np.int32), NamedSharding(mesh, P(axis))
+    )
+    return out, counts_arr
+
+
+def unshard_columns(cols: Sequence, counts, capacity: int) -> List[List[np.ndarray]]:
+    """Inverse of shard_columns: global padded arrays → per-shard valid
+    host chunks."""
+    counts = np.asarray(counts)
+    nshards = len(counts)
+    out = []
+    for c in cols:
+        c = np.asarray(c)
+        chunks = []
+        for s in range(nshards):
+            start = s * capacity
+            chunks.append(c[start : start + int(counts[s])])
+        out.append(chunks)
+    return out

@@ -1,0 +1,86 @@
+"""Example-pipeline tests (mirrors example/max_test.go and the demo
+programs)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+import bigslice_tpu as bs
+from bigslice_tpu import slicetest
+from bigslice_tpu.exec.session import Session
+import bigslice_tpu.models.kmeans as kmeans_mod
+import bigslice_tpu.models.maxint as maxint
+import bigslice_tpu.models.wordcount as wc_mod
+
+
+def test_int_max_random_vs_oracle():
+    # Property-style check mirroring example/max_test.go's quick.Check.
+    rng = np.random.RandomState(0)
+    for trial in range(3):
+        n = rng.randint(1, 2000)
+        nshards = rng.randint(1, 8)
+        keys = rng.randint(0, 50, n).astype(np.int32)
+        vals = rng.randint(-1000, 1000, n).astype(np.int32)
+        s = maxint.int_max(bs.Const(nshards, keys, vals))
+        got = dict(slicetest.scan_all(s))
+        oracle = {}
+        for k, v in zip(keys.tolist(), vals.tolist()):
+            oracle[k] = max(oracle.get(k, -10**9), v)
+        assert got == oracle
+
+
+def test_wordcount_file(tmp_path):
+    p = tmp_path / "t.txt"
+    p.write_text("a b a\nc a b\n")
+    got = dict(slicetest.scan_all(wc_mod.wordcount(3, str(p))))
+    assert got == {"a": 3, "b": 2, "c": 1}
+
+
+def test_wordcount_ids_device():
+    rng = np.random.RandomState(1)
+    ids = rng.randint(0, 100, 5000).astype(np.int32)
+    got = dict(slicetest.scan_all(wc_mod.wordcount_ids(4, ids, 100)))
+    oracle = dict(zip(*np.unique(ids, return_counts=True)))
+    assert got == {int(k): int(v) for k, v in oracle.items()}
+
+
+def test_kmeans_step_single_device():
+    rng = np.random.RandomState(2)
+    pts = rng.rand(256, 8).astype(np.float32)
+    cents = pts[:4].copy()
+    out = np.asarray(jax.jit(kmeans_mod.kmeans_step)(pts, cents))
+    # One manual step oracle.
+    d2 = ((pts[:, None, :] - cents[None, :, :]) ** 2).sum(-1)
+    assign = d2.argmin(1)
+    for c in range(4):
+        m = assign == c
+        if m.any():
+            np.testing.assert_allclose(out[c], pts[m].mean(0), rtol=1e-4)
+
+
+def test_mesh_kmeans_step():
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("shards",))
+    rng = np.random.RandomState(3)
+    pts = rng.rand(8 * 32, 8).astype(np.float32)
+    cents = pts[:4].copy()
+    step = kmeans_mod.mesh_kmeans_step(mesh, k=4, d=8)
+    pts_g = jax.device_put(pts, NamedSharding(mesh, P("shards")))
+    out = np.asarray(step(pts_g, cents))
+    single = np.asarray(jax.jit(kmeans_mod.kmeans_step)(pts, cents))
+    np.testing.assert_allclose(out, single, rtol=1e-4)
+
+
+def test_kmeans_slice_api_converges():
+    rng = np.random.RandomState(4)
+    # Three well-separated blobs.
+    blobs = [rng.randn(50, 4).astype(np.float32) + 10 * i
+             for i in range(3)]
+    pts = np.concatenate(blobs)
+    rng.shuffle(pts)
+    sess = Session()
+    cents = kmeans_mod.kmeans(sess, pts, k=3, iters=5, num_shards=3)
+    centers = sorted(round(float(c[0]) / 10) for c in cents)
+    assert centers == [0, 1, 2]

@@ -1,0 +1,186 @@
+"""SPMD shuffle + mesh reduce tests on the 8-device virtual CPU mesh.
+
+The hermetic multi-"chip" validation strategy (SURVEY.md §4 takeaway):
+the full collective path — hash bucket, all_to_all, counts exchange,
+compaction, segmented combines — runs in-process on virtual devices.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from bigslice_tpu.frame import ops as frame_ops
+from bigslice_tpu.parallel import shuffle as shuffle_mod
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    from jax.sharding import Mesh
+
+    devs = np.array(jax.devices()[:8])
+    return Mesh(devs, ("shards",))
+
+
+def make_sharded(mesh, rng, total, cap, nkeys=1, nvals=1, key_range=100):
+    n = mesh.devices.size
+    per = total // n
+    key_chunks = [[rng.randint(0, key_range, per).astype(np.int32)
+                   for _ in range(n)] for _ in range(nkeys)]
+    val_chunks = [[rng.randint(0, 10, per).astype(np.int32)
+                   for _ in range(n)] for _ in range(nvals)]
+    cols, counts = shuffle_mod.shard_columns(
+        mesh, key_chunks + val_chunks, [per] * n, cap
+    )
+    return key_chunks, val_chunks, cols, counts
+
+
+def test_mesh_shuffle_routes_by_hash(mesh):
+    rng = np.random.RandomState(0)
+    n = mesh.devices.size
+    cap = 256
+    key_chunks, val_chunks, cols, counts = make_sharded(
+        mesh, rng, total=8 * 100, cap=cap
+    )
+    sh = shuffle_mod.MeshShuffle(mesh, ncols=2, nkeys=1, capacity=cap)
+    out_cols, out_counts, overflow = sh(cols, counts)
+    assert int(overflow) == 0
+    chunks = shuffle_mod.unshard_columns(out_cols, out_counts,
+                                         sh.out_capacity)
+
+    # Oracle: every input row must appear on the shard its key hashes to.
+    all_in = sorted(
+        zip(np.concatenate(key_chunks[0]).tolist(),
+            np.concatenate(val_chunks[0]).tolist())
+    )
+    all_out = sorted(
+        zip(np.concatenate(chunks[0]).tolist(),
+            np.concatenate(chunks[1]).tolist())
+    )
+    assert all_in == all_out  # no loss, no dup
+    for s in range(n):
+        keys = chunks[0][s]
+        if not len(keys):
+            continue
+        h = frame_ops.hash_device_column(np.asarray(keys), 0)
+        np.testing.assert_array_equal(
+            (h % np.uint32(n)).astype(np.int32), np.full(len(keys), s)
+        )
+
+
+def test_mesh_shuffle_overflow_detected(mesh):
+    # All rows share one key → everything routes to one shard; with
+    # capacity < total rows the overflow must be reported, not silent.
+    n = mesh.devices.size
+    cap = 16
+    per = 16
+    key_chunks = [[np.full(per, 7, np.int32) for _ in range(n)]]
+    val_chunks = [[np.arange(per, dtype=np.int32) for _ in range(n)]]
+    cols, counts = shuffle_mod.shard_columns(
+        mesh, key_chunks + val_chunks, [per] * n, cap
+    )
+    sh = shuffle_mod.MeshShuffle(mesh, ncols=2, nkeys=1, capacity=cap)
+    _, _, overflow = sh(cols, counts)
+    assert int(overflow) > 0
+
+
+def test_mesh_reduce_by_key_matches_oracle(mesh):
+    rng = np.random.RandomState(1)
+    cap = 512
+    key_chunks, val_chunks, cols, counts = make_sharded(
+        mesh, rng, total=8 * 200, cap=cap, key_range=37
+    )
+    red = shuffle_mod.MeshReduceByKey(
+        mesh, nkeys=1, nvals=1, capacity=cap,
+        combine_fn=lambda a, b: a + b,
+    )
+    k_out, v_out, out_counts, overflow = red(
+        [cols[0]], [cols[1]], counts
+    )
+    assert int(overflow) == 0
+    chunks = shuffle_mod.unshard_columns(k_out + v_out, out_counts,
+                                         red.out_capacity)
+    got = {}
+    for s in range(mesh.devices.size):
+        for k, v in zip(chunks[0][s].tolist(), chunks[1][s].tolist()):
+            assert k not in got, f"key {k} on two shards"
+            got[k] = v
+    oracle = {}
+    for k, v in zip(np.concatenate(key_chunks[0]).tolist(),
+                    np.concatenate(val_chunks[0]).tolist()):
+        oracle[k] = oracle.get(k, 0) + v
+    assert got == oracle
+
+
+def test_mesh_reduce_multikey_multival(mesh):
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(2)
+    n = mesh.devices.size
+    cap = 256
+    per = 64
+    k1 = [rng.randint(0, 5, per).astype(np.int32) for _ in range(n)]
+    k2 = [rng.randint(0, 5, per).astype(np.int32) for _ in range(n)]
+    v1 = [rng.randint(0, 100, per).astype(np.int32) for _ in range(n)]
+    v2 = [rng.rand(per).astype(np.float32) for _ in range(n)]
+    cols, counts = shuffle_mod.shard_columns(
+        mesh, [k1, k2, v1, v2], [per] * n, cap
+    )
+
+    def fn(a, b):
+        return (a[0] + b[0], jnp.maximum(a[1], b[1]))
+
+    red = shuffle_mod.MeshReduceByKey(mesh, nkeys=2, nvals=2,
+                                      capacity=cap, combine_fn=fn)
+    k_out, v_out, out_counts, overflow = red(cols[:2], cols[2:], counts)
+    assert int(overflow) == 0
+    chunks = shuffle_mod.unshard_columns(k_out + v_out, out_counts,
+                                         red.out_capacity)
+    got = {}
+    for s in range(n):
+        for a, b, x, y in zip(*(c[s].tolist() for c in chunks)):
+            got[(a, b)] = (x, y)
+    oracle = {}
+    for a, b, x, y in zip(
+        np.concatenate(k1).tolist(), np.concatenate(k2).tolist(),
+        np.concatenate(v1).tolist(), np.concatenate(v2).tolist(),
+    ):
+        cur = oracle.get((a, b))
+        oracle[(a, b)] = (
+            (cur[0] + x, max(cur[1], y)) if cur else (x, y)
+        )
+    assert set(got) == set(oracle)
+    for k in got:
+        assert got[k][0] == oracle[k][0]
+        assert abs(got[k][1] - oracle[k][1]) < 1e-6
+
+
+def test_mesh_shuffle_custom_partitioner(mesh):
+    n = mesh.devices.size
+    cap = 128
+    per = 32
+    keys = [np.arange(per, dtype=np.int32) + s * per for s in range(n)]
+    cols, counts = shuffle_mod.shard_columns(mesh, [keys], [per] * n, cap)
+    sh = shuffle_mod.MeshShuffle(
+        mesh, ncols=1, nkeys=1, capacity=cap,
+        partition_fn=lambda k: k % 2,  # everything to shards 0/1
+    )
+    out_cols, out_counts, overflow = sh(cols, counts)
+    assert int(overflow) == 0
+    counts_host = np.asarray(out_counts)
+    assert counts_host[0] + counts_host[1] == n * per
+    assert all(c == 0 for c in counts_host[2:])
+
+
+def test_empty_shards(mesh):
+    n = mesh.devices.size
+    cap = 64
+    keys = [np.zeros(0, np.int32) for _ in range(n)]
+    vals = [np.zeros(0, np.int32) for _ in range(n)]
+    cols, counts = shuffle_mod.shard_columns(mesh, [keys, vals],
+                                             [0] * n, cap)
+    red = shuffle_mod.MeshReduceByKey(mesh, nkeys=1, nvals=1, capacity=cap,
+                                      combine_fn=lambda a, b: a + b)
+    _, _, out_counts, overflow = red([cols[0]], [cols[1]], counts)
+    assert int(np.asarray(out_counts).sum()) == 0
+    assert int(overflow) == 0
