@@ -2,6 +2,7 @@ from bigslice_tpu.exec.task import Task, TaskDep, TaskName, TaskState, TaskError
 from bigslice_tpu.exec.compile import compile_slice
 from bigslice_tpu.exec.evaluate import evaluate
 from bigslice_tpu.exec.session import Session, Result, start
+from bigslice_tpu.exec.local import LocalExecutor
 
 __all__ = [
     "Task",
@@ -14,4 +15,15 @@ __all__ = [
     "Session",
     "Result",
     "start",
+    "LocalExecutor",
+    "MeshExecutor",
 ]
+
+
+def __getattr__(name):
+    # MeshExecutor imports jax machinery; load lazily.
+    if name == "MeshExecutor":
+        from bigslice_tpu.exec.meshexec import MeshExecutor
+
+        return MeshExecutor
+    raise AttributeError(name)

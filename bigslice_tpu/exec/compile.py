@@ -51,9 +51,18 @@ def _is_result(slice_: Slice) -> bool:
     return isinstance(slice_, Result)
 
 
+import itertools as _itertools
+
+_compiler_serial = _itertools.count(1)
+
+
 class Compiler:
     def __init__(self, inv_index: int):
         self.inv_index = inv_index
+        # Monotonic serial (not id(self): ids recycle after GC and could
+        # merge op groups from different compilations in group-keyed
+        # executors).
+        self.serial = next(_compiler_serial)
         self._memo: Dict[Tuple[int, int], List[Task]] = {}
 
     def compile(self, slice_: Slice,
@@ -135,18 +144,26 @@ class Compiler:
                     # Aligned read: shard i reads dep shard i's partition 0.
                     deps.append(TaskDep((dep_tasks[shard],), 0))
             name = TaskName(self.inv_index, op_name, shard, num_tasks)
-            tasks.append(
-                Task(
-                    name=name,
-                    do=_make_do(chain, shard),
-                    deps=deps,
-                    partitioner=part,
-                    schema=slice_.schema,
-                    procs=slice_.procs,
-                    exclusive=slice_.exclusive,
-                    slice_names=slice_names,
-                )
+            task = Task(
+                name=name,
+                do=_make_do(chain, shard),
+                deps=deps,
+                partitioner=part,
+                schema=slice_.schema,
+                procs=slice_.procs,
+                exclusive=slice_.exclusive,
+                slice_names=slice_names,
             )
+            # Structural metadata for executors that vectorize whole op
+            # groups (the mesh executor runs all shards of a fused chain
+            # as one SPMD program).
+            task.chain = chain
+            # The memo key disambiguates same-op task sets compiled for
+            # different partition configs (e.g. Reduce vs Reshuffle
+            # consumers of one slice) — they must never merge into one
+            # executor op group.
+            task.group_key = (self.inv_index, op_name, self.serial, key)
+            tasks.append(task)
         self._memo[key] = tasks
         return tasks
 

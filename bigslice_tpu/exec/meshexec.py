@@ -1,0 +1,505 @@
+"""The mesh executor: SPMD execution of op groups over a device mesh.
+
+Where the local executor runs each task as a host thread, this executor
+recognizes that all shards of a fused op are the *same program* on
+different data — the SPMD insight — and runs the whole op group as ONE
+jitted ``shard_map`` computation over a ``jax.sharding.Mesh``:
+
+- shard i's rows live on device i (row-sharded global arrays + a valid
+  count per device; static power-of-two capacities per group,
+  SURVEY.md §7.3(1));
+- fused Map/Filter stages execute as vmapped device stages inside the
+  program (the reference's pipelined reflection loop,
+  exec/bigmachine.go:950-1023, becomes one XLA fusion);
+- a task's output partitioner lowers to the hash-bucket + all_to_all
+  shuffle (parallel/shuffle.py), with map-side combining as the
+  segmented-scan kernel — shuffle edges in the task DAG become ICI
+  collectives rather than stored partitions;
+- groups that are not device-eligible (host columns, host functions,
+  Cogroup, custom partitioners, sinks) fall back to the local executor.
+  A store bridge materializes device outputs as frames on demand, so
+  fallback consumers and result scans read mesh outputs transparently.
+
+Eligibility (v1): the group's shard count equals the mesh size; its
+output partition count is 1 or the mesh size; every chain stage is a
+supported op with a device-tier schema. Everything else falls back —
+correctness never depends on the mesh path.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from bigslice_tpu import sliceio
+from bigslice_tpu.frame.frame import Frame
+from bigslice_tpu.exec import store as store_mod
+from bigslice_tpu.exec.local import DepLost, LocalExecutor
+from bigslice_tpu.exec.task import Task, TaskName, TaskState
+from bigslice_tpu.parallel import segment
+from bigslice_tpu.parallel.jitutil import bucket_size
+from bigslice_tpu.parallel.meshutil import get_shard_map, mesh_axis
+from bigslice_tpu.parallel import shuffle as shuffle_mod
+
+# Group-completion watchdog: if the evaluator hands us only part of an op
+# group (other shards already OK from a prior run), run the stragglers on
+# the fallback executor rather than waiting forever.
+GROUP_WAIT_SECS = 0.25
+
+
+class DeviceGroupOutput:
+    """A group's output resident on the mesh: row-sharded global columns
+    plus per-device valid counts. When ``partitioned``, device p holds
+    partition p (post-shuffle, merged over sources); otherwise device s
+    holds shard s's output."""
+
+    def __init__(self, cols, counts, capacity: int, schema,
+                 partitioned: bool):
+        self.cols = cols
+        self.counts = counts
+        self.capacity = capacity
+        self.schema = schema
+        self.partitioned = partitioned
+        self._chunks = None
+        self._chunks_lock = threading.Lock()
+
+    def host_chunks(self) -> List[List[np.ndarray]]:
+        # Memoized: every (task, partition) read would otherwise pull the
+        # whole global output device→host again.
+        with self._chunks_lock:
+            if self._chunks is None:
+                self._chunks = shuffle_mod.unshard_columns(
+                    self.cols, np.asarray(self.counts), self.capacity
+                )
+            return self._chunks
+
+
+class _BridgedStore(store_mod.MemoryStore):
+    """The frame store shared with the fallback executor, extended to
+    serve mesh-resident group outputs: a read that misses the frame tier
+    materializes from the device tier."""
+
+    def __init__(self, owner: "MeshExecutor"):
+        super().__init__()
+        self.owner = owner
+
+    def read(self, name, partition):
+        try:
+            return super().read(name, partition)
+        except store_mod.Missing:
+            frames = self.owner._frames_by_name(name, partition)
+            if frames is None:
+                raise
+            return iter(frames)
+
+    def committed(self, name, partition):
+        return (super().committed(name, partition)
+                or self.owner._has_device_output(name))
+
+
+class _GroupState:
+    def __init__(self, num_shard: int):
+        self.num_shard = num_shard
+        self.tasks: Dict[int, Task] = {}
+        self.launched = False
+        self.timer: Optional[threading.Timer] = None
+
+
+class MeshExecutor:
+    name = "mesh"
+
+    def __init__(self, mesh, fallback_procs: Optional[int] = None):
+        self.mesh = mesh
+        self.nmesh = int(mesh.devices.size)
+        self.store = _BridgedStore(self)
+        self.local = LocalExecutor(procs=fallback_procs, store=self.store)
+        self._lock = threading.Lock()
+        self._groups: Dict[Tuple, _GroupState] = {}
+        self._outputs: Dict[Tuple, DeviceGroupOutput] = {}
+        self._task_index: Dict[TaskName, Tuple[Tuple, Task]] = {}
+        self._programs: Dict[Tuple, Tuple[object, list]] = {}
+
+    def start(self, session) -> None:
+        self.session = session
+        self.local.start(session)
+
+    # -- Executor interface ----------------------------------------------
+
+    def submit(self, task: Task) -> None:
+        if not self._eligible(task):
+            self.local.submit(task)
+            return
+        key = task.group_key
+        complete = False
+        with self._lock:
+            g = self._groups.get(key)
+            if g is None:
+                g = self._groups[key] = _GroupState(task.name.num_shard)
+            g.tasks[task.name.shard] = task
+            complete = len(g.tasks) == g.num_shard and not g.launched
+            if complete:
+                g.launched = True
+                if g.timer:
+                    g.timer.cancel()
+            elif g.timer is None and not g.launched:
+                g.timer = threading.Timer(
+                    GROUP_WAIT_SECS, self._flush_stragglers, (key,)
+                )
+                g.timer.daemon = True
+                g.timer.start()
+        if complete:
+            threading.Thread(
+                target=self._run_group, args=(key,), daemon=True
+            ).start()
+
+    def reader(self, task: Task, partition: int) -> sliceio.Reader:
+        return self.store.read(task.name, partition)
+
+    def discard(self, task: Task) -> None:
+        with self._lock:
+            self._outputs.pop(task.group_key, None)
+            self._task_index.pop(task.name, None)
+        self.local.discard(task)
+
+    # -- eligibility ------------------------------------------------------
+
+    def _eligible(self, task: Task) -> bool:
+        if task.chain is None or task.name.num_shard != self.nmesh:
+            return False
+        if task.num_partition not in (1, self.nmesh):
+            return False
+        if not all(ct.is_device for ct in task.schema):
+            return False
+        part = task.partitioner
+        if task.num_partition > 1:
+            if part.partition_fn is not None:
+                return False  # custom partitioners run host-tier (v1)
+            if part.combiner is not None and not getattr(
+                part.combiner, "device", False
+            ):
+                return False
+        for dep in task.deps:
+            if len(dep.tasks) not in (1, self.nmesh):
+                return False
+        from bigslice_tpu.ops.const import Const
+        from bigslice_tpu.ops.mapops import Filter, Map, _PrefixedSlice
+        from bigslice_tpu.ops.reduce import Reduce
+        from bigslice_tpu.ops.reshuffle import Reshard, Reshuffle
+        from bigslice_tpu.ops.source import ReaderFunc
+
+        for s in task.chain:
+            if isinstance(s, (Const, ReaderFunc, _PrefixedSlice,
+                              Reshuffle, Reshard)):
+                if not all(ct.is_device for ct in s.schema):
+                    return False
+                continue
+            if isinstance(s, (Map, Filter)):
+                if s.mode != "jax":
+                    return False
+                continue
+            if isinstance(s, Reduce):
+                if not s.frame_combiner.device:
+                    return False
+                continue
+            return False
+        return True
+
+    # -- group orchestration ----------------------------------------------
+
+    def _flush_stragglers(self, key) -> None:
+        with self._lock:
+            g = self._groups.get(key)
+            if g is None or g.launched:
+                return
+            g.launched = True
+            del self._groups[key]
+            tasks = list(g.tasks.values())
+        for t in tasks:
+            self.local.submit(t)
+
+    def _run_group(self, key) -> None:
+        with self._lock:
+            g = self._groups.pop(key)
+        tasks = [g.tasks[s] for s in range(g.num_shard)]
+        claimed = []
+        for t in tasks:
+            if t.transition_if(TaskState.WAITING, TaskState.RUNNING):
+                claimed.append(t)
+        if len(claimed) != len(tasks):
+            # Another evaluation claimed part of the group: release ours
+            # back to the fallback path.
+            for t in claimed:
+                t.set_state(TaskState.WAITING)
+                self.local.submit(t)
+            return
+        try:
+            self._execute_group(key, tasks)
+            with self._lock:
+                for t in tasks:
+                    self._task_index[t.name] = (key, t)
+            for t in tasks:
+                t.mark_ok()
+        except DepLost as e:
+            e.producer.mark_lost(e)
+            for t in tasks:
+                t.mark_lost(e)
+        except Exception as e:  # noqa: BLE001
+            for t in tasks:
+                t.set_state(TaskState.ERR, e)
+
+    # -- the SPMD program --------------------------------------------------
+
+    def _execute_group(self, key, tasks: List[Task]) -> None:
+        task0 = tasks[0]
+        cols, counts, capacity = self._group_input(tasks)
+        # Skew handling: retry with geometrically larger per-destination
+        # bucket slack; slack == nmesh makes overflow impossible (a
+        # source can send at most `capacity` rows to one destination).
+        # This is the recompile-averse bucketing strategy from SURVEY.md
+        # §7.3(1)/(5) — a bounded set of compiled programs, no dynamic
+        # shapes.
+        slack = 2.0
+        while True:
+            program, stages = self._program(task0, capacity, slack)
+            extras = [
+                np.asarray(a)
+                for kind, _, s in stages if kind == "map"
+                for a in s.args
+            ]
+            out_counts, overflow, out_cols = program(
+                counts, *cols, *extras
+            )
+            has_shuffle = any(k == "shuffle" for k, _, _ in stages)
+            if not has_shuffle or int(np.asarray(overflow)) == 0:
+                break
+            if slack >= self.nmesh:
+                raise RuntimeError(
+                    f"mesh shuffle overflow in group {task0.name.op} "
+                    f"even at full slack"
+                )
+            slack = min(slack * 4, float(self.nmesh))
+        out_capacity = (
+            self.nmesh
+            * shuffle_mod.send_capacity(capacity, self.nmesh, slack)
+            if has_shuffle else capacity
+        )
+        self._outputs[key] = DeviceGroupOutput(
+            list(out_cols), out_counts, out_capacity, task0.schema,
+            partitioned=task0.num_partition > 1,
+        )
+
+    def _group_input(self, tasks: List[Task]):
+        """Build (global cols, counts, capacity) for the group's input."""
+        task0 = tasks[0]
+        if not task0.deps:
+            # Host source: run each shard's reader, upload.
+            return self._upload(
+                [sliceio.read_all(
+                    t.chain[-1].reader(t.name.shard, []),
+                    t.chain[-1].schema,
+                ).to_host() for t in tasks]
+            )
+        # Single-dep chains only (multi-dep groups are ineligible).
+        pkey = task0.deps[0].tasks[0].group_key
+        out = self._outputs.get(pkey)
+        if out is not None and len(task0.deps[0].tasks) == self.nmesh:
+            # Device-resident shuffle output: device p already holds
+            # partition p == consumer shard p. Zero-copy reuse.
+            return out.cols, out.counts, out.capacity
+        if (out is not None and len(task0.deps[0].tasks) == 1
+                and not out.partitioned):
+            # Aligned (materialize-boundary) dep, device-resident.
+            return out.cols, out.counts, out.capacity
+        # Fallback-produced dep: load frames from the store per shard.
+        per_shard_frames = []
+        for t in tasks:
+            dep = t.deps[0]
+            frames = []
+            for p in dep.tasks:
+                try:
+                    frames.extend(self.store.read(p.name, dep.partition))
+                except store_mod.Missing as e:
+                    raise DepLost(p) from e
+            schema = dep.tasks[0].schema
+            per_shard_frames.append(
+                Frame.concat(frames).to_host() if frames
+                else Frame.empty(schema)
+            )
+        return self._upload(per_shard_frames)
+
+    def _upload(self, per_shard_frames: List[Frame]):
+        counts = [len(f) for f in per_shard_frames]
+        ncols = per_shard_frames[0].num_cols
+        per_shard_cols = [
+            [f.cols[j] for f in per_shard_frames] for j in range(ncols)
+        ]
+        capacity = bucket_size(max(counts + [1]))
+        cols, counts_arr = shuffle_mod.shard_columns(
+            self.mesh, per_shard_cols, counts, capacity
+        )
+        return cols, counts_arr, capacity
+
+    def _stages_for(self, task: Task) -> List[tuple]:
+        """Flatten the chain (innermost→outermost) + output partitioner
+        into device stage descriptors (kind, struct_id, slice)."""
+        from bigslice_tpu.ops.mapops import Filter, Map
+        from bigslice_tpu.ops.reduce import Reduce
+
+        stages: List[tuple] = []
+        for s in reversed(task.chain):
+            if isinstance(s, Map):
+                stages.append(("map", (id(s.fn), len(s.args)), s))
+            elif isinstance(s, Filter):
+                stages.append(("filter", id(s.pred), s))
+            elif isinstance(s, Reduce):
+                fc = s.frame_combiner
+                stages.append(("combine", (id(fc.fn), fc.nkeys, fc.nvals),
+                               s))
+        if task.num_partition > 1:
+            fc = task.partitioner.combiner
+            stages.append((
+                "shuffle",
+                (task.schema.prefix, id(fc.fn) if fc else None),
+                task,
+            ))
+        return stages
+
+    def _program(self, task: Task, capacity: int, slack: float = 2.0):
+        stages = self._stages_for(task)
+        key = (tuple((k, sid) for k, sid, _ in stages), capacity,
+               task.num_partition, len(task.schema),
+               self._input_ncols(task), slack)
+        cached = self._programs.get(key)
+        if cached is not None:
+            return cached[0], stages
+
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        axis = mesh_axis(self.mesh)
+        nmesh = self.nmesh
+        shard_map = get_shard_map()
+        n_extras = sum(
+            len(s.args) for kind, _, s in stages if kind == "map"
+        )
+        in_ncols = self._input_ncols(task)
+
+        def stepped(counts, *cols_and_extras):
+            n = counts[0]
+            cols = list(cols_and_extras[:in_ncols])
+            extras = list(cols_and_extras[in_ncols:])
+            overflow = jnp.int32(0)
+            for kind, _, s in stages:
+                if kind == "map":
+                    nargs = len(s.args)
+                    stage_extras, extras = extras[:nargs], extras[nargs:]
+                    vfn = jax.vmap(
+                        s.fn,
+                        in_axes=(0,) * len(cols) + (None,) * nargs,
+                    )
+                    out = vfn(*cols, *stage_extras)
+                    if not isinstance(out, (tuple, list)):
+                        out = (out,)
+                    cols = [jnp.asarray(o) for o in out]
+                elif kind == "filter":
+                    size = cols[0].shape[0]
+                    mask = jax.vmap(s.pred)(*cols)
+                    keep = mask & (jnp.arange(size, dtype=np.int32) < n)
+                    drop = (~keep).astype(np.int32)
+                    packed = lax.sort((drop,) + tuple(cols), num_keys=1,
+                                      is_stable=True)
+                    cols = list(packed[1:])
+                    n = keep.sum().astype(np.int32)
+                elif kind == "combine":
+                    fc = s.frame_combiner
+                    core = segment.make_segmented_reduce(
+                        fc.nkeys, fc.nvals,
+                        segment.canonical_combine(fc.fn, fc.nvals),
+                    )
+                    n, keys, vals = core(
+                        n, tuple(cols[: fc.nkeys]),
+                        tuple(cols[fc.nkeys :]),
+                    )
+                    cols = list(keys) + list(vals)
+                else:  # shuffle
+                    part = s.partitioner
+                    fc = part.combiner
+                    nkeys = s.schema.prefix
+                    if fc is not None:
+                        core = segment.make_segmented_reduce(
+                            fc.nkeys, fc.nvals,
+                            segment.canonical_combine(fc.fn, fc.nvals),
+                        )
+                        n, keys, vals = core(
+                            n, tuple(cols[: fc.nkeys]),
+                            tuple(cols[fc.nkeys :]),
+                        )
+                        cols = list(keys) + list(vals)
+                    body = shuffle_mod.make_shuffle_fn(
+                        nmesh, nkeys, cols[0].shape[0], axis, slack=slack
+                    )
+                    n, ov, cols = body(n, *cols)
+                    cols = list(cols)
+                    overflow = overflow + ov
+            return (jnp.asarray(n).reshape(1), overflow, tuple(cols))
+
+        ncols_out = len(task.schema)
+        col_spec = P(axis)
+        in_specs = (
+            (P(axis),)
+            + tuple(col_spec for _ in range(in_ncols))
+            + tuple(P() for _ in range(n_extras))
+        )
+        out_specs = (P(axis), P(),
+                     tuple(col_spec for _ in range(ncols_out)))
+        prog = jax.jit(
+            shard_map(stepped, mesh=self.mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=False)
+        )
+        self._programs[key] = (prog, stages)
+        return prog, stages
+
+    def _input_ncols(self, task: Task) -> int:
+        innermost = task.chain[-1]
+        deps = innermost.deps()
+        if deps:
+            return len(deps[0].slice.schema)
+        return len(innermost.schema)
+
+    # -- frame materialization for fallback/result consumers --------------
+
+    def _has_device_output(self, name: TaskName) -> bool:
+        with self._lock:
+            return name in self._task_index
+
+    def _frames_by_name(self, name: TaskName,
+                        partition: int) -> Optional[List[Frame]]:
+        with self._lock:
+            entry = self._task_index.get(name)
+            if entry is None:
+                return None
+            key, task = entry
+            out = self._outputs.get(key)
+        if out is None:
+            return None
+        chunks = out.host_chunks()
+        shard = task.name.shard
+        if out.partitioned:
+            # Post-shuffle: device p holds partition p merged over
+            # sources; attribute it all to producer shard 0 so the union
+            # over producers stays correct for concat/re-combine
+            # consumers.
+            if shard != 0:
+                return []
+            cols = [c[partition] for c in chunks]
+        else:
+            if partition != 0:
+                return []
+            cols = [c[shard] for c in chunks]
+        if not len(cols[0]):
+            return []
+        return [Frame(cols, task.schema)]

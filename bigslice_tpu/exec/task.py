@@ -108,6 +108,11 @@ class Task:
         self.exclusive = exclusive
         self.slice_names = tuple(slice_names)
         self.scope = metrics_mod.Scope()
+        # Structural metadata set by the compiler: the fused slice chain
+        # (outermost first) and an op-group key shared by all shards of
+        # the same compiled op (mesh executor vectorization).
+        self.chain = None
+        self.group_key = None
 
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)

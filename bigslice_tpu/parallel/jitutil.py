@@ -85,7 +85,11 @@ class PaddedVmap:
         return [np.asarray(o)[:n] for o in out], n
 
 
-_VMAP_CACHE: dict = {}
+# Keyed by id(fn) with an aliveness guard; bounded FIFO so loops that
+# construct fresh lambdas can't grow the cache (and its compiled
+# executables) without limit.
+_VMAP_CACHE: "dict" = {}
+_VMAP_CACHE_MAX = 128
 
 
 def get_padded_vmap(fn: Callable) -> PaddedVmap:
@@ -98,11 +102,14 @@ def get_padded_vmap(fn: Callable) -> PaddedVmap:
     entry = _VMAP_CACHE.get(key)
     if entry is not None:
         ref, pv = entry
-        if ref() is fn:
+        if ref is None or ref() is fn:
             return pv
     pv = PaddedVmap(fn)
     try:
-        _VMAP_CACHE[key] = (weakref.ref(fn), pv)
-    except TypeError:  # unweakrefable callables: no caching
-        pass
+        ref = weakref.ref(fn)
+    except TypeError:  # unweakrefable callables
+        ref = None
+    _VMAP_CACHE[key] = (ref, pv)
+    while len(_VMAP_CACHE) > _VMAP_CACHE_MAX:
+        _VMAP_CACHE.pop(next(iter(_VMAP_CACHE)))
     return pv

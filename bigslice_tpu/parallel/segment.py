@@ -128,7 +128,10 @@ class DeviceReduceByKey:
         )
 
 
+# Keyed by id(fn) with an aliveness guard; bounded FIFO (see
+# jitutil._VMAP_CACHE rationale).
 _KERNEL_CACHE: dict = {}
+_KERNEL_CACHE_MAX = 128
 
 
 def cached_reduce_kernel(fn: Callable, nkeys: int, nvals: int
@@ -136,14 +139,22 @@ def cached_reduce_kernel(fn: Callable, nkeys: int, nvals: int
     """Share DeviceReduceByKey instances (and their jit caches) across
     combiners built from the same function object — iterative sessions
     re-running the same Reduce then compile once, not once per run."""
-    key = (id(fn), nkeys, nvals)
-    kern = _KERNEL_CACHE.get(key)
-    if kern is None or kern._fn_ref() is not fn:
-        kern = DeviceReduceByKey(fn, nkeys, nvals)
-        import weakref
+    import weakref
 
-        kern._fn_ref = weakref.ref(fn)
-        _KERNEL_CACHE[key] = kern
+    key = (id(fn), nkeys, nvals)
+    entry = _KERNEL_CACHE.get(key)
+    if entry is not None:
+        ref, kern = entry
+        if ref is None or ref() is fn:
+            return kern
+    kern = DeviceReduceByKey(fn, nkeys, nvals)
+    try:
+        ref = weakref.ref(fn)
+    except TypeError:  # unweakrefable callables
+        ref = None
+    _KERNEL_CACHE[key] = (ref, kern)
+    while len(_KERNEL_CACHE) > _KERNEL_CACHE_MAX:
+        _KERNEL_CACHE.pop(next(iter(_KERNEL_CACHE)))
     return kern
 
 
