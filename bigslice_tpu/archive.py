@@ -1,0 +1,50 @@
+"""Archive sources: slices over tar files.
+
+Mirrors ``archive/tarslice`` (archive/tarslice/tarslice.go:29): a slice
+whose rows are (name, payload) for each entry of a tar archive, entries
+striped across shards. Payload bytes are host-tier columns; downstream
+device work typically begins after a parse/tokenize Map.
+"""
+
+from __future__ import annotations
+
+import tarfile
+
+from bigslice_tpu import typecheck
+from bigslice_tpu.slicetype import Schema
+from bigslice_tpu.frame.frame import Frame
+from bigslice_tpu import sliceio
+from bigslice_tpu.ops.base import Slice, make_name
+
+
+class TarSlice(Slice):
+    """``TarSlice(num_shards, path)`` → rows of (name: str, data: bytes);
+    entry ``i`` belongs to shard ``i % num_shards``."""
+
+    def __init__(self, num_shards: int, path: str):
+        typecheck.check(num_shards >= 1, "tarslice: num_shards must be >= 1")
+        super().__init__(Schema([str, bytes], prefix=1), num_shards,
+                         make_name("tarslice"))
+        self.path = path
+
+    def reader(self, shard, deps):
+        def read():
+            batch = []
+            with tarfile.open(self.path, "r:*") as tf:
+                i = -1
+                for member in tf:
+                    if not member.isfile():
+                        continue
+                    i += 1
+                    if i % self.num_shards != shard:
+                        continue
+                    fp = tf.extractfile(member)
+                    data = fp.read() if fp is not None else b""
+                    batch.append((member.name, data))
+                    if len(batch) >= sliceio.DEFAULT_CHUNK_ROWS:
+                        yield Frame.from_rows(batch, self.schema)
+                        batch = []
+            if batch:
+                yield Frame.from_rows(batch, self.schema)
+
+        return read()

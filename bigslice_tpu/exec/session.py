@@ -70,18 +70,61 @@ class Result(Slice):
 
 
 class Session:
-    """Lifecycle + options (exec/session.go:68-176)."""
+    """Lifecycle + options (exec/session.go:68-176).
+
+    Options mirror the reference's session options:
+    - ``parallelism``: local proc limit (exec/session.go:127-140)
+    - ``trace_path``: write a Chrome trace of task scheduling on
+      shutdown (TracePath, exec/session.go:160-164); analyze with
+      ``python -m bigslice_tpu.tools.slicetrace``
+    - ``status``: live per-op task-state lines on stderr
+      (base/status display analog)
+    - ``eventer``: callable ``(event_name, **fields)`` receiving coarse
+      session analytics events (sessionStart/taskComplete,
+      exec/session.go:256-261, exec/eval.go:160-165)
+    - ``monitor``: raw ``(task, state)`` transition callback
+    """
 
     def __init__(self, executor=None, parallelism: Optional[int] = None,
-                 monitor=None):
+                 monitor=None, trace_path: Optional[str] = None,
+                 status: bool = False, eventer=None):
+        from bigslice_tpu.utils import status as status_mod
+        from bigslice_tpu.utils import trace as trace_mod
+
         if executor is None:
             from bigslice_tpu.exec.local import LocalExecutor
 
             executor = LocalExecutor(procs=parallelism)
         self.executor = executor
-        self.monitor = monitor
+        self.eventer = eventer
+        self.trace_path = trace_path
+        self.tracer = trace_mod.Tracer() if trace_path else None
+        self.status = status_mod.Status()
+        self._printer = None
+        if status:
+            self._printer = status_mod.StatusPrinter(self.status)
+            self._printer.start()
+        monitors = [monitor, self.status]
+        if self.tracer is not None:
+            monitors.append(trace_mod.TaskTraceMonitor(self.tracer))
+        if eventer is not None:
+            monitors.append(self._event_monitor)
+        self.monitor = status_mod.chain_monitors(*monitors)
         self._inv_index = itertools.count(1)
         executor.start(self)
+        self._event("bigslice:sessionStart", executor=executor.name)
+
+    def _event(self, name: str, **fields) -> None:
+        if self.eventer is not None:
+            self.eventer(name, **fields)
+        if self.tracer is not None:
+            self.tracer.instant(name, **fields)
+
+    def _event_monitor(self, task, state) -> None:
+        from bigslice_tpu.exec.task import TaskState
+
+        if state == TaskState.OK:
+            self.eventer("bigslice:taskComplete", task=str(task.name))
 
     def run(self, func: Any, *args) -> Result:
         """Compile and evaluate ``func(*args)`` (exec/session.go:214-225).
@@ -119,7 +162,11 @@ class Session:
     must = run
 
     def shutdown(self) -> None:
-        pass
+        if self._printer is not None:
+            self._printer.stop()
+        if self.tracer is not None and self.trace_path:
+            self.tracer.save(self.trace_path)
+            self._event("bigslice:traceSaved", path=self.trace_path)
 
 
 def start(executor=None, **kwargs) -> Session:

@@ -131,4 +131,15 @@ def write_stream(fp: BinaryIO, frames) -> int:
 
 
 def read_stream(fp: BinaryIO) -> Iterator[Frame]:
-    return read_frames(fp.read())
+    """Incrementally decode frames from a file object — one frame's bytes
+    resident at a time (spill-merge reads depend on this bound)."""
+    while True:
+        header = fp.read(16)
+        if not header:
+            return
+        if len(header) < 16 or header[:4] != MAGIC:
+            raise CorruptionError("bad frame header in stream")
+        (blen, _crc) = struct.unpack_from("<QI", header, 4)
+        body = fp.read(blen)
+        frame, _ = decode_frame(header + body)
+        yield frame

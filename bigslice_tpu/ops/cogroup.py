@@ -69,41 +69,32 @@ class Cogroup(Slice):
         nk = self.prefix
 
         def read():
-            # Materialize + key-sort each dep's partition stream.
-            # (External spill for beyond-memory partitions arrives with the
-            # spiller integration; the reference sorts each dep the same
-            # way via sortio, cogroup.go:150-177.)
-            sorted_deps = []
-            for i, dep in enumerate(deps):
-                schema = self.slices[i].schema
-                frame = sliceio.read_all(dep(), schema).to_host()
-                sorted_deps.append(frame.sorted_by_key())
+            # Externally sort each dep's partition stream (device sort
+            # per run, disk spill beyond the run budget — sortio), then
+            # stream a heap-free sorted-merge of groups across deps
+            # (cogroup.go:150-177, 191-260 semantics on bounded memory).
+            from bigslice_tpu import sortio
 
-            cursors = [0] * len(sorted_deps)
+            cursors = [
+                _Cursor(
+                    sortio.sort_reader(dep(), self.slices[i].schema),
+                    nk,
+                    len(self.slices[i].schema) - nk,
+                )
+                for i, dep in enumerate(deps)
+            ]
             out_rows = []
             while True:
-                # Find the smallest current key across deps.
                 best = None
-                for i, f in enumerate(sorted_deps):
-                    if cursors[i] >= len(f):
-                        continue
-                    k = tuple(c[cursors[i]] for c in f.cols[:nk])
-                    if best is None or k < best:
+                for cur in cursors:
+                    k = cur.key()
+                    if k is not None and (best is None or k < best):
                         best = k
                 if best is None:
                     break
                 row = list(best)
-                for i, f in enumerate(sorted_deps):
-                    start = cursors[i]
-                    end = start
-                    n = len(f)
-                    while end < n and tuple(
-                        c[end] for c in f.cols[:nk]
-                    ) == best:
-                        end += 1
-                    cursors[i] = end
-                    for c in f.cols[nk:]:
-                        row.append(list(c[start:end]))
+                for cur in cursors:
+                    row.extend(cur.take_group(best))
                 out_rows.append(tuple(row))
                 if len(out_rows) >= sliceio.DEFAULT_CHUNK_ROWS:
                     yield Frame.from_rows(out_rows, self.schema)
@@ -112,3 +103,53 @@ class Cogroup(Slice):
                 yield Frame.from_rows(out_rows, self.schema)
 
         return read()
+
+
+class _Cursor:
+    """Buffered cursor over a key-sorted frame stream: exposes the current
+    key and extracts whole groups (which may span frame boundaries)."""
+
+    def __init__(self, reader, nk: int, nvals: int):
+        self.reader = reader
+        self.nk = nk
+        self.nvals = nvals
+        self.frame = None
+        self.i = 0
+        self._advance_frame()
+
+    def _advance_frame(self):
+        for f in self.reader:
+            if len(f):
+                self.frame = f.to_host()
+                self.i = 0
+                return
+        self.frame = None
+
+    def key(self):
+        if self.frame is None:
+            return None
+        return tuple(c[self.i] for c in self.frame.cols[: self.nk])
+
+    def take_group(self, key):
+        """Collect the value-column lists for all contiguous rows equal to
+        ``key`` (empty lists if the cursor's current key differs)."""
+        groups = None
+        while self.frame is not None and self.key() == key:
+            f, start = self.frame, self.i
+            n = len(f)
+            end = start
+            while end < n and tuple(
+                c[end] for c in f.cols[: self.nk]
+            ) == key:
+                end += 1
+            if groups is None:
+                groups = [[] for _ in range(f.num_cols - self.nk)]
+            for j, c in enumerate(f.cols[self.nk :]):
+                groups[j].extend(c[start:end])
+            self.i = end
+            if self.i >= n:
+                self._advance_frame()
+        if groups is None:
+            # Current key differs: contribute empty groups.
+            return [[] for _ in range(self.nvals)]
+        return groups

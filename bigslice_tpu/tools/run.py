@@ -1,0 +1,46 @@
+"""Program runner (cmd/bigslice `run` analog).
+
+The reference's CLI builds fat binaries so one artifact serves driver and
+cloud workers (cmd/bigslice/bigslicecmd/build.go:28-77); in the SPMD
+model every host simply runs the same Python program, so `run` reduces
+to: bootstrap a configured session, then execute the user program.
+
+Usage:
+    python -m bigslice_tpu.tools.run [-local] [-status] [-trace T] \
+        program.py [program args...]
+
+The program receives the configured session via
+``bigslice_tpu.sliceconfig.current_session()`` (also re-exported here).
+"""
+
+from __future__ import annotations
+
+import runpy
+import sys
+
+from bigslice_tpu import sliceconfig
+
+
+def current_session():
+    return sliceconfig.current_session()
+
+
+def main(argv=None):
+    argv = argv if argv is not None else sys.argv[1:]
+    sess, rest = sliceconfig.parse(argv)
+    if not rest:
+        print("usage: python -m bigslice_tpu.tools.run [flags] "
+              "program.py [args...]", file=sys.stderr)
+        return 2
+    sliceconfig.set_current_session(sess)
+    prog, prog_args = rest[0], rest[1:]
+    sys.argv = [prog] + prog_args
+    try:
+        runpy.run_path(prog, run_name="__main__")
+    finally:
+        sess.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

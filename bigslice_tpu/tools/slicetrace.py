@@ -1,0 +1,70 @@
+"""Offline trace analyzer (cmd/slicetrace analog).
+
+Reads a session's Chrome trace file (Session(trace_path=...)) and prints
+per-op duration reports with quartiles (cmd/slicetrace/main.go:20-50,
+quartile.go).
+
+Usage: python -m bigslice_tpu.tools.slicetrace TRACE.json
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Dict, List
+
+
+def quartiles(xs: List[float]):
+    xs = sorted(xs)
+    n = len(xs)
+
+    def q(p: float) -> float:
+        if n == 1:
+            return xs[0]
+        i = p * (n - 1)
+        lo = int(i)
+        hi = min(lo + 1, n - 1)
+        return xs[lo] + (xs[hi] - xs[lo]) * (i - lo)
+
+    return q(0.25), q(0.5), q(0.75)
+
+
+def analyze(path: str) -> str:
+    with open(path) as fp:
+        doc = json.load(fp)
+    by_op: Dict[str, List[float]] = {}
+    instants = []
+    for ev in doc.get("traceEvents", []):
+        if ev.get("ph") == "X":
+            by_op.setdefault(ev["name"], []).append(ev["dur"] / 1e3)
+        elif ev.get("ph") == "i":
+            instants.append(ev["name"])
+    lines = [f"{path}: {sum(len(v) for v in by_op.values())} task runs, "
+             f"{len(instants)} events"]
+    lines.append(
+        f"{'op':<50} {'n':>5} {'q1_ms':>10} {'med_ms':>10} "
+        f"{'q3_ms':>10} {'total_ms':>10}"
+    )
+    for op, durs in sorted(by_op.items(),
+                           key=lambda kv: -sum(kv[1])):
+        q1, q2, q3 = quartiles(durs)
+        lines.append(
+            f"{op[:50]:<50} {len(durs):>5} {q1:>10.2f} {q2:>10.2f} "
+            f"{q3:>10.2f} {sum(durs):>10.2f}"
+        )
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    argv = argv if argv is not None else sys.argv[1:]
+    if not argv:
+        print("usage: python -m bigslice_tpu.tools.slicetrace TRACE.json",
+              file=sys.stderr)
+        return 2
+    for path in argv:
+        print(analyze(path))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

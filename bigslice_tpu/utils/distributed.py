@@ -1,0 +1,61 @@
+"""Multi-host coordination over DCN via jax.distributed.
+
+The reference distributes by shipping function identities + serialized
+args to bigmachine-bootstrapped worker processes over RPC (doc.go:23-31,
+SURVEY.md §5.8). The TPU-native model replaces that wholesale: every
+host runs the *same SPMD Python program* (which IS the Func-registry
+determinism guarantee, enforced by construction — SURVEY.md §7.1), with
+
+- device collectives (all_to_all/psum) over ICI for the data plane, and
+- the jax.distributed service over DCN for control-plane coordination
+  (process bootstrap, global device discovery, barrier semantics).
+
+On a TPU pod, ``initialize()`` with no arguments picks up the platform's
+environment; elsewhere pass coordinator/num_processes/process_id
+explicitly. After initialization, ``jax.devices()`` spans every host's
+chips and a mesh built over it makes the mesh executor's collectives ride
+ICI within slices and DCN across them.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+_initialized = False
+
+
+def initialize(coordinator: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Initialize multi-host jax (idempotent)."""
+    global _initialized
+    if _initialized:
+        return
+    import jax
+
+    kwargs = {}
+    if coordinator is not None:
+        kwargs["coordinator_address"] = coordinator
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    jax.distributed.initialize(**kwargs)
+    _initialized = True
+
+
+def is_coordinator() -> bool:
+    """True on the driver host (process 0) — where driver-only work
+    (result scanning to files, status display) should run."""
+    import jax
+
+    return jax.process_index() == 0
+
+
+def global_mesh(axis: str = "shards"):
+    """A 1-D mesh over every chip visible across all hosts."""
+    import numpy as np
+    import jax
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(jax.devices()), (axis,))

@@ -1,0 +1,96 @@
+"""Live session status: per-slice task-state aggregation.
+
+Mirrors the reference's status plumbing (exec/slicestatus.go:84-160 +
+base/status): task state transitions aggregate into per-op counters
+(INIT/WAITING/RUNNING/OK/ERR/LOST) that render as live status lines on a
+TTY (and are queryable programmatically). The hierarchical HTTP status
+page arrives with the debug server.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from typing import Dict, Optional
+
+from bigslice_tpu.exec.task import TaskState
+
+
+class Status:
+    """Aggregated task counts per op group."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._task_state: Dict[str, TaskState] = {}
+        self._op_of: Dict[str, str] = {}
+
+    def __call__(self, task, state) -> None:
+        with self._lock:
+            key = str(task.name)
+            self._task_state[key] = state
+            self._op_of[key] = task.name.op
+
+    def counts(self) -> Dict[str, Dict[str, int]]:
+        with self._lock:
+            out: Dict[str, Dict[str, int]] = {}
+            for key, state in self._task_state.items():
+                op = self._op_of[key]
+                d = out.setdefault(op, {})
+                d[state.name] = d.get(state.name, 0) + 1
+            return out
+
+    def render(self) -> str:
+        lines = []
+        for op, states in sorted(self.counts().items()):
+            total = sum(states.values())
+            ok = states.get("OK", 0)
+            running = states.get("RUNNING", 0)
+            err = states.get("ERR", 0) + states.get("LOST", 0)
+            line = f"  {op}: {ok}/{total} done"
+            if running:
+                line += f", {running} running"
+            if err:
+                line += f", {err} failed/lost"
+            lines.append(line)
+        return "\n".join(lines)
+
+
+class StatusPrinter:
+    """Background TTY printer (the reference's live status display)."""
+
+    def __init__(self, status: Status, interval: float = 1.0,
+                 stream=None):
+        self.status = status
+        self.interval = interval
+        self.stream = stream or sys.stderr
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        last = ""
+        while not self._stop.wait(self.interval):
+            cur = self.status.render()
+            if cur and cur != last:
+                print(cur, file=self.stream, flush=True)
+                last = cur
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2)
+
+
+def chain_monitors(*monitors):
+    """Compose monitors (evaluator accepts a single callable)."""
+    mons = [m for m in monitors if m is not None]
+
+    def monitor(task, state):
+        for m in mons:
+            m(task, state)
+
+    return monitor

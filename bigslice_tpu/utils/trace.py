@@ -1,0 +1,104 @@
+"""Chrome-trace-format session tracing.
+
+Mirrors the reference's tracer (exec/tracer.go:29-219 +
+internal/trace): task lifecycle events are collected as Chrome trace
+"X" (complete) events — executors are "processes", concurrent tasks get
+virtual thread lanes — and written as one JSON file per session
+(``TracePath`` option, exec/session.go:160-164). The offline analyzer is
+``python -m bigslice_tpu.tools.slicetrace`` (cmd/slicetrace analog).
+
+On TPU this complements (not replaces) jax.profiler/XPlane traces: this
+file shows *task-level* scheduling; XLA-level timing comes from the jax
+profiler.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+class Tracer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._events: List[dict] = []
+        self._open: Dict[str, dict] = {}
+        self._tids: Dict[str, int] = {}
+        self._free_tids: List[int] = []
+        self._t0 = time.perf_counter()
+
+    def _now_us(self) -> float:
+        return (time.perf_counter() - self._t0) * 1e6
+
+    def begin(self, key: str, name: str, pid: str = "executor",
+              **args) -> None:
+        with self._lock:
+            tid = (self._free_tids.pop()
+                   if self._free_tids else len(self._tids) + 1)
+            self._tids[key] = tid
+            self._open[key] = {
+                "name": name,
+                "pid": pid,
+                "tid": tid,
+                "ts": self._now_us(),
+                "args": args,
+            }
+
+    def end(self, key: str, **args) -> None:
+        with self._lock:
+            ev = self._open.pop(key, None)
+            if ev is None:
+                return
+            tid = self._tids.pop(key, 1)
+            self._free_tids.append(tid)
+            ev["args"].update(args)
+            # B/E coalesced to one X event (exec/tracer.go:185-219).
+            self._events.append({
+                "name": ev["name"],
+                "ph": "X",
+                "pid": ev["pid"],
+                "tid": ev["tid"],
+                "ts": ev["ts"],
+                "dur": self._now_us() - ev["ts"],
+                "args": ev["args"],
+            })
+
+    def instant(self, name: str, pid: str = "session", **args) -> None:
+        with self._lock:
+            self._events.append({
+                "name": name,
+                "ph": "i",
+                "pid": pid,
+                "tid": 0,
+                "ts": self._now_us(),
+                "s": "g",
+                "args": args,
+            })
+
+    def events(self) -> List[dict]:
+        with self._lock:
+            return list(self._events)
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as fp:
+            json.dump({"traceEvents": self.events()}, fp)
+
+
+class TaskTraceMonitor:
+    """An evaluator monitor recording task state transitions as trace
+    events (wired by Session when trace_path is set)."""
+
+    def __init__(self, tracer: Tracer):
+        self.tracer = tracer
+
+    def __call__(self, task, state) -> None:
+        from bigslice_tpu.exec.task import TaskState
+
+        key = str(task.name)
+        if state == TaskState.RUNNING:
+            self.tracer.begin(key, task.name.op, pid="tasks",
+                              shard=task.name.shard)
+        elif state in (TaskState.OK, TaskState.ERR, TaskState.LOST):
+            self.tracer.end(key, state=state.name)

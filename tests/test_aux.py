@@ -1,0 +1,159 @@
+"""Auxiliary subsystem tests: metrics, stats, tracing, status, config,
+CLI tools, tar source, topn (SURVEY.md §2.7-2.8 parity)."""
+
+import io
+import json
+import os
+import tarfile
+
+import numpy as np
+import pytest
+
+import bigslice_tpu as bs
+from bigslice_tpu import slicetest
+from bigslice_tpu.exec.session import Session
+from bigslice_tpu.utils import metrics, stats, topn
+from bigslice_tpu.utils.status import Status
+from bigslice_tpu.utils.trace import Tracer
+
+
+def test_metrics_flow_task_to_result():
+    counter = metrics.new_counter("rows_seen")
+
+    def count_row(x):
+        counter.incr()
+        return (x,)
+
+    s = bs.Map(bs.Const(3, ["a", "b", "c", "d"]), count_row, out=[str])
+    res = slicetest.run(s)
+    assert counter.value(res.scope) == 4
+
+
+def test_metrics_merge():
+    c = metrics.new_counter("m")
+    s1, s2 = metrics.Scope(), metrics.Scope()
+    s1.incr(c, 2)
+    s2.incr(c, 3)
+    s1.merge(s2)
+    assert s1.value(c) == 5
+    assert s1.snapshot()["m"] == 5
+
+
+def test_stats_map():
+    m = stats.Map()
+    m.incr("read", 10)
+    m.incr("read", 5)
+    assert m.get("read") == 15
+    assert m.snapshot() == {"read": 15}
+
+
+def test_tracer_records_task_events(tmp_path):
+    path = str(tmp_path / "trace.json")
+    sess = Session(trace_path=path)
+    sess.run(bs.Map(bs.Const(3, np.arange(9, dtype=np.int32)),
+                    lambda x: x + 1))
+    sess.shutdown()
+    with open(path) as fp:
+        doc = json.load(fp)
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert len(xs) == 3  # one per task
+    assert all(e["dur"] >= 0 for e in xs)
+    starts = [e for e in doc["traceEvents"]
+              if e["name"] == "bigslice:sessionStart"]
+    assert starts
+
+
+def test_slicetrace_analyzer(tmp_path, capsys):
+    path = str(tmp_path / "t.json")
+    sess = Session(trace_path=path)
+    sess.run(bs.Const(2, np.arange(4, dtype=np.int32)))
+    sess.shutdown()
+    from bigslice_tpu.tools import slicetrace
+
+    assert slicetrace.main([path]) == 0
+    out = capsys.readouterr().out
+    assert "task runs" in out and "med_ms" in out
+
+
+def test_status_counts():
+    status = Status()
+    sess = Session(monitor=status)
+    sess.run(bs.Const(4, np.arange(8, dtype=np.int32)))
+    counts = status.counts()
+    assert len(counts) == 1
+    (op, states), = counts.items()
+    assert states == {"OK": 4} or states.get("OK") == 4
+    assert "4/4 done" in status.render()
+
+
+def test_eventer_receives_events():
+    events = []
+    sess = Session(eventer=lambda name, **kw: events.append(name))
+    sess.run(bs.Const(2, np.arange(4, dtype=np.int32)))
+    assert "bigslice:sessionStart" in events
+    assert events.count("bigslice:taskComplete") == 2
+
+
+def test_sliceconfig_profile_roundtrip(tmp_path, monkeypatch):
+    from bigslice_tpu import sliceconfig
+
+    path = str(tmp_path / "config")
+    sliceconfig.write_profile({"executor": "local", "parallelism": 3},
+                              path)
+    cfg = sliceconfig.load_profile(path)
+    assert cfg["executor"] == "local"
+    assert cfg["parallelism"] == 3
+    assert cfg["status"] is False  # defaults fill in
+
+
+def test_sliceconfig_parse_local(monkeypatch, tmp_path):
+    from bigslice_tpu import sliceconfig
+
+    monkeypatch.setattr(sliceconfig, "CONFIG_PATH",
+                        str(tmp_path / "none"))
+    sess, rest = sliceconfig.parse(["-local", "prog.py", "arg"])
+    assert rest == ["prog.py", "arg"]
+    from bigslice_tpu.exec.local import LocalExecutor
+
+    assert isinstance(sess.executor, LocalExecutor)
+
+
+def test_run_cli(tmp_path, monkeypatch, capsys):
+    from bigslice_tpu.tools import run as run_mod
+    from bigslice_tpu import sliceconfig
+
+    monkeypatch.setattr(sliceconfig, "CONFIG_PATH",
+                        str(tmp_path / "none"))
+    prog = tmp_path / "prog.py"
+    prog.write_text(
+        "import numpy as np\n"
+        "import bigslice_tpu as bs\n"
+        "from bigslice_tpu.tools.run import current_session\n"
+        "sess = current_session()\n"
+        "res = sess.run(bs.Const(2, np.arange(6, dtype=np.int32)))\n"
+        "print('CLI_OK', sorted(res.rows()))\n"
+    )
+    assert run_mod.main(["-local", str(prog)]) == 0
+    assert "CLI_OK" in capsys.readouterr().out
+
+
+def test_tarslice(tmp_path):
+    from bigslice_tpu.archive import TarSlice
+
+    tar_path = str(tmp_path / "a.tar")
+    with tarfile.open(tar_path, "w") as tf:
+        for name, data in [("x.txt", b"xx"), ("y.txt", b"yyy"),
+                           ("z.txt", b"z")]:
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+    rows = slicetest.sorted_rows(TarSlice(2, tar_path))
+    assert rows == [("x.txt", b"xx"), ("y.txt", b"yyy"), ("z.txt", b"z")]
+
+
+def test_topn():
+    t = topn.TopN(3)
+    for score, item in [(5, "a"), (1, "b"), (9, "c"), (7, "d"), (3, "e")]:
+        t.add(score, item)
+    assert [it for _, it in t.items()] == ["c", "d", "a"]
+    assert topn.top_n([(1, "x"), (2, "y")], 1) == [(2, "y")]

@@ -1,0 +1,85 @@
+"""Fault-injection tests (mirrors exec/chaosmonkey_test.go:44-103):
+random loss of stored task outputs while a shuffle pipeline runs; the
+run must still complete correctly via lost-task resubmission."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import bigslice_tpu as bs
+from bigslice_tpu.exec import store as store_mod
+from bigslice_tpu.exec.local import LocalExecutor
+from bigslice_tpu.exec.session import Session
+
+
+class FlakyStore(store_mod.MemoryStore):
+    """Randomly drops committed outputs on read — the moral equivalent of
+    machines dying between producing and serving shuffle data."""
+
+    def __init__(self, rng, loss_rate=0.04, max_losses=8):
+        super().__init__()
+        self.rng = rng
+        self.loss_rate = loss_rate
+        self.losses = 0
+        self.max_losses = max_losses
+        self._flock = threading.Lock()
+
+    def read(self, name, partition):
+        with self._flock:
+            sabotage = (self.losses < self.max_losses
+                        and self.rng.rand() < self.loss_rate)
+            if sabotage:
+                self.losses += 1
+        if sabotage:
+            self.discard(name)
+        return super().read(name, partition)
+
+
+def test_reduce_survives_random_output_loss(monkeypatch):
+    # Loosen the consecutive-loss cap the way the reference's chaos test
+    # shortens ProbationTimeout (exec/chaosmonkey_test.go:58-61): the
+    # point is recovery, not the cap.
+    import sys
+
+    import bigslice_tpu.exec.evaluate  # noqa: F401 — ensure module import
+
+    evaluate_mod = sys.modules["bigslice_tpu.exec.evaluate"]
+    monkeypatch.setattr(evaluate_mod, "MAX_CONSECUTIVE_LOST", 25)
+    rng = np.random.RandomState(0)
+    store = FlakyStore(rng)
+    sess = Session(executor=LocalExecutor(procs=4, store=store))
+    keys = np.arange(2000, dtype=np.int32) % 97
+    vals = np.ones(2000, dtype=np.int32)
+    r = bs.Reduce(bs.Const(10, keys, vals), lambda a, b: a + b)
+    res = sess.run(r)
+    oracle = {}
+    for k in keys.tolist():
+        oracle[k] = oracle.get(k, 0) + 1
+    assert dict(res.rows()) == oracle
+    assert store.losses > 0  # chaos actually happened
+
+
+def test_discard_races_evaluation():
+    """Concurrent discard + re-read (TestDiscardChaos analog)."""
+    sess = Session()
+    base = sess.run(bs.Const(6, np.arange(600, dtype=np.int32)))
+    stop = threading.Event()
+    errs = []
+
+    def discarder():
+        while not stop.is_set():
+            base.tasks[0].session = None  # no-op poke
+            base.discard()
+            time.sleep(0.01)
+
+    t = threading.Thread(target=discarder, daemon=True)
+    t.start()
+    try:
+        for _ in range(10):
+            rows = sorted(base.rows())
+            assert rows == [(i,) for i in range(600)]
+    finally:
+        stop.set()
+        t.join(timeout=5)
