@@ -57,8 +57,12 @@ _compiler_serial = _itertools.count(1)
 
 
 class Compiler:
-    def __init__(self, inv_index: int):
+    def __init__(self, inv_index: int, machine_combiners: bool = False):
         self.inv_index = inv_index
+        # MachineCombiners: share one combiner buffer per process across
+        # all producer tasks of a shuffle (exec/session.go:166-176,
+        # worker-side two-level combine exec/bigmachine.go:1084-1210).
+        self.machine_combiners = machine_combiners
         # Monotonic serial (not id(self): ids recycle after GC and could
         # merge op groups from different compilations in group-keyed
         # executors).
@@ -109,10 +113,21 @@ class Compiler:
         dep_task_lists: List[Tuple[List[Task], bool]] = []
         for dep in innermost.deps():
             if dep.shuffle:
+                comb = _frame_combiner(innermost)
+                combine_key = ""
+                if self.machine_combiners and comb is not None:
+                    # Deterministic per (dep slice, partitioning, fn):
+                    # equivalent consumers generate the same key, so
+                    # producer-task memoization still shares their work.
+                    combine_key = (
+                        f"mc-{self.inv_index}-{self.serial}-"
+                        f"{id(dep.slice)}-{num_tasks}-{id(comb.fn)}"
+                    )
                 dep_part = Partitioner(
                     num_partition=num_tasks,
                     partition_fn=dep.partitioner,
-                    combiner=_frame_combiner(innermost),
+                    combiner=comb,
+                    combine_key=combine_key,
                 )
             else:
                 # Non-shuffle boundary (materialized dep or multi-dep):
@@ -138,7 +153,13 @@ class Compiler:
             for dep_tasks, dep in dep_task_lists:
                 if dep.shuffle:
                     deps.append(
-                        TaskDep(tuple(dep_tasks), shard, expand=dep.expand)
+                        TaskDep(
+                            tuple(dep_tasks), shard, expand=dep.expand,
+                            combine_key=(
+                                dep_tasks[0].partitioner.combine_key
+                                if dep_tasks else ""
+                            ),
+                        )
                     )
                 else:
                     # Aligned read: shard i reads dep shard i's partition 0.

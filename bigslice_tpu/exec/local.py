@@ -83,6 +83,14 @@ class LocalExecutor:
         self.procs = procs or os.cpu_count() or 4
         self._limiter = _Limiter(self.procs)
         self.store = store or store_mod.MemoryStore()
+        # Machine (process) level shared combiners (MachineCombiners):
+        # per combine key, the partitioned contributions of each producer
+        # shard; combined once when the last shard lands (the worker-side
+        # two-level combine + CommitCombiner, exec/bigmachine.go:1084-1301).
+        self._mc_lock = threading.Lock()
+        self._mc_contrib: dict = {}        # ck -> {shard: [parts]}
+        self._mc_committed: dict = {}      # (ck, p) -> Frame
+        self._mc_keys_committed: set = set()
 
     def start(self, session) -> None:
         self.session = session
@@ -97,12 +105,39 @@ class LocalExecutor:
 
     def discard(self, task: Task) -> None:
         self.store.discard(task.name)
+        # Free machine-combiner buffers this task consumed.
+        with self._mc_lock:
+            for dep in task.deps:
+                if dep.combine_key:
+                    self._mc_contrib.pop(dep.combine_key, None)
+                    self._mc_keys_committed.discard(dep.combine_key)
+                    for p in range(len(dep.tasks)):
+                        self._mc_committed.pop((dep.combine_key, p), None)
         task.set_state(TaskState.LOST,
                        RuntimeError("task discarded"))
 
     # -- task execution ----------------------------------------------------
 
     def _dep_factory(self, dep):
+        if dep.combine_key:
+            # Machine-combined dep: one shared, already-combined buffer
+            # per partition (read once, not per producer task). A missing
+            # commit means the producer group's buffers are gone — surface
+            # as a lost dep, never as silently-empty input.
+            def mc_factory():
+                with self._mc_lock:
+                    committed = dep.combine_key in self._mc_keys_committed
+                    frame = self._mc_committed.get(
+                        (dep.combine_key, dep.partition)
+                    )
+                if not committed:
+                    raise DepLost(dep.tasks[0])
+                if frame is None or not len(frame):
+                    return sliceio.empty_reader()
+                return iter([frame])
+
+            return mc_factory
+
         def open_one(t):
             try:
                 return self.store.read(t.name, dep.partition)
@@ -159,6 +194,10 @@ class LocalExecutor:
                 if len(sub):
                     parts[p].append(sub)
         comb = task.combiner
+        ck = task.partitioner.combine_key
+        if comb is not None and ck:
+            self._machine_combine(task, parts)
+            return
         for p in range(nparts):
             if comb is not None:
                 out = comb.combine_frames(parts[p])
@@ -166,3 +205,46 @@ class LocalExecutor:
             else:
                 frames = parts[p]
             self.store.put(task.name, p, frames)
+
+    def _machine_combine(self, task: Task, parts: List[List[Frame]]) -> None:
+        """Contribute this shard's partitioned output to the shared
+        machine combiner; the last shard in combines and commits
+        (CommitCombiner's write-once role, exec/bigmachine.go:1234-1301;
+        rerun contributions replace rather than duplicate)."""
+        ck = task.partitioner.combine_key
+        nparts = task.num_partition
+        with self._mc_lock:
+            if ck in self._mc_keys_committed:
+                # Post-commit producer rerun: the raw contributions were
+                # freed at commit, so a partial recombine would be wrong.
+                # Machine combining trades retry granularity for memory
+                # (see Session docstring) — fail loudly.
+                raise RuntimeError(
+                    f"machine combiner {ck} received a contribution after "
+                    f"commit (producer rerun); rerun the whole session or "
+                    f"disable machine_combiners for lossy executors"
+                )
+            contrib = self._mc_contrib.setdefault(ck, {})
+            contrib[task.name.shard] = parts
+            complete = len(contrib) == task.name.num_shard
+            snapshot = dict(contrib) if complete else None
+        # Per-task store entries stay empty: consumers read the shared
+        # committed buffers via the combine_key dep path.
+        for p in range(nparts):
+            self.store.put(task.name, p, [])
+        if not complete:
+            return
+        comb = task.combiner
+        committed = {}
+        for p in range(nparts):
+            frames: List[Frame] = []
+            for shard_parts in snapshot.values():
+                frames.extend(shard_parts[p])
+            out = comb.combine_frames(frames)
+            committed[(ck, p)] = out
+        with self._mc_lock:
+            self._mc_committed.update(committed)
+            self._mc_keys_committed.add(ck)
+            # Raw contributions are no longer needed: free them (the
+            # feature's memory benefit).
+            self._mc_contrib.pop(ck, None)

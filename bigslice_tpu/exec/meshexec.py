@@ -173,6 +173,11 @@ class MeshExecutor:
         if not all(ct.is_device for ct in task.schema):
             return False
         part = task.partitioner
+        if part.combine_key or any(d.combine_key for d in task.deps):
+            # Machine-combined groups coordinate through the local
+            # executor's shared process buffers; the device path has its
+            # own (inherent) per-device combining, so these run fallback.
+            return False
         if task.num_partition > 1:
             if part.partition_fn is not None:
                 return False  # custom partitioners run host-tier (v1)

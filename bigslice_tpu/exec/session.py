@@ -45,9 +45,26 @@ class Result(Slice):
         task = self.tasks[shard]
 
         def read():
-            if task.state != TaskState.OK:
-                evaluate(self.session.executor, [task])
-            yield from self.session.executor.reader(task, 0)
+            from bigslice_tpu.exec.evaluate import MAX_CONSECUTIVE_LOST
+            from bigslice_tpu.exec.store import Missing
+
+            # Re-evaluate-before-read with retry: outputs may vanish
+            # between evaluation and the scan (machine loss); mark the
+            # task lost and re-run its (transitive) producers
+            # (newEvalReader, exec/bigmachine.go:1485-1535).
+            last = None
+            for _ in range(MAX_CONSECUTIVE_LOST):
+                if task.state != TaskState.OK:
+                    evaluate(self.session.executor, [task])
+                try:
+                    r = self.session.executor.reader(task, 0)
+                except Missing as e:
+                    last = e
+                    task.mark_lost(e)
+                    continue
+                yield from r
+                return
+            raise last
 
         return read()
 
@@ -82,12 +99,18 @@ class Session:
     - ``eventer``: callable ``(event_name, **fields)`` receiving coarse
       session analytics events (sessionStart/taskComplete,
       exec/session.go:256-261, exec/eval.go:160-165)
+    - ``machine_combiners``: share one combiner buffer per process
+      across all of a shuffle's producer tasks (MachineCombiners,
+      exec/session.go:166-176) — fewer, larger combines at the cost of
+      coarser retry granularity
     - ``monitor``: raw ``(task, state)`` transition callback
     """
 
     def __init__(self, executor=None, parallelism: Optional[int] = None,
                  monitor=None, trace_path: Optional[str] = None,
-                 status: bool = False, eventer=None):
+                 status: bool = False, eventer=None,
+                 machine_combiners: bool = False,
+                 debug_port: Optional[int] = None):
         from bigslice_tpu.utils import status as status_mod
         from bigslice_tpu.utils import trace as trace_mod
 
@@ -110,6 +133,12 @@ class Session:
         if eventer is not None:
             monitors.append(self._event_monitor)
         self.monitor = status_mod.chain_monitors(*monitors)
+        self.machine_combiners = machine_combiners
+        self.debug = None
+        if debug_port is not None:
+            from bigslice_tpu.utils.debughttp import DebugServer
+
+            self.debug = DebugServer(self, debug_port)
         self._inv_index = itertools.count(1)
         executor.start(self)
         self._event("bigslice:sessionStart", executor=executor.name)
@@ -154,7 +183,11 @@ class Session:
                 "run: expected Func, Slice, or callable, got %s",
                 type(func).__name__,
             )
-        tasks = compile_mod.Compiler(inv_index).compile(slice_)
+        tasks = compile_mod.Compiler(
+            inv_index, machine_combiners=self.machine_combiners
+        ).compile(slice_)
+        if self.debug is not None:
+            self.debug.register_roots(tasks)
         evaluate(self.executor, tasks, monitor=self.monitor)
         return Result(self, slice_, tasks)
 
@@ -164,6 +197,8 @@ class Session:
     def shutdown(self) -> None:
         if self._printer is not None:
             self._printer.stop()
+        if self.debug is not None:
+            self.debug.close()
         if self.tracer is not None and self.trace_path:
             self.tracer.save(self.trace_path)
             self._event("bigslice:traceSaved", path=self.trace_path)

@@ -93,3 +93,47 @@ def func(fn: Callable[..., Slice] = None, *, exclusive: bool = False):
 def registered() -> Sequence[Func]:
     with _registry_lock:
         return tuple(_registry)
+
+
+def registry_digest() -> str:
+    """Stable digest of the Func registry (name+index order).
+
+    The reference verifies that driver and workers registered identical
+    Funcs in identical order, diffing locations on mismatch
+    (func.go:201-207, 276-343; exercised by cmd/badfuncs). In the SPMD
+    model all hosts run the same program, but drift (conditional
+    registration, import-order divergence) is still possible — compare
+    this digest across processes at distributed bootstrap to fail
+    fast (wired in utils/distributed.initialize).
+    """
+    import hashlib
+
+    h = hashlib.sha256()
+    for f in registered():
+        h.update(f"{f.index}:{f.name}\n".encode())
+    return h.hexdigest()
+
+
+def verify_registry_across_hosts() -> None:
+    """Raise if hosts disagree on the Func registry (multi-host only).
+
+    Uses the jax.distributed key-value store via a broadcast of the
+    digest from process 0.
+    """
+    import jax
+
+    if jax.process_count() == 1:
+        return
+    import numpy as np
+
+    from jax.experimental import multihost_utils
+
+    digest = registry_digest()
+    local = np.frombuffer(bytes.fromhex(digest), dtype=np.uint8)
+    global_ = multihost_utils.broadcast_one_to_all(local)
+    if not np.array_equal(local, np.asarray(global_)):
+        raise RuntimeError(
+            "bigslice_tpu Func registry differs between hosts: "
+            "ensure every process registers the same @func definitions "
+            "in the same order (no conditional registration)"
+        )

@@ -1,0 +1,140 @@
+"""Pallas TPU kernels — the framework's "native tier".
+
+The reference's native tier is Go/unsafe kernels for the columnar hot
+ops (frame/unsafe.go, SURVEY.md §2.9); here it is Mosaic/Pallas. The
+first resident kernel fuses the shuffle's hottest pass — murmur-mix key
+hashing, partition-id assignment, and the per-destination histogram —
+into one VMEM-resident sweep (hash + mod + bincount would otherwise be
+separate XLA ops with an HBM round-trip for the histogram's sort-based
+lowering).
+
+Layout: keys are processed as (rows, 128) lane-aligned blocks (the VPU's
+8×128 shape; last dim always 128 — pallas_guide.md tiling constraints).
+The histogram accumulates across sequential grid steps in a VMEM
+accumulator block (revisiting-output pattern).
+
+On CPU (tests, virtual mesh) the kernels run in interpreter mode;
+Mosaic compiles them natively on TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import numpy as np
+
+LANES = 128
+
+
+def _interpret() -> bool:
+    import jax
+
+    return jax.default_backend() != "tpu"
+
+
+@functools.lru_cache(maxsize=64)
+def _build_hash_partition(nparts: int, block_rows: int, seed32: int,
+                          interpret: bool):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    # Histogram lanes: one partition per lane, padded to a lane multiple.
+    hist_lanes = ((nparts + LANES - 1) // LANES) * LANES
+
+    def kernel(keys_ref, ids_ref, counts_ref):
+        step = pl.program_id(0)
+
+        # murmur3 finalizer (matches frame/ops.py fmix32 bit-for-bit).
+        x = keys_ref[:].astype(jnp.uint32) ^ jnp.uint32(seed32)
+        x = x ^ (x >> 16)
+        x = x * jnp.uint32(0x85EBCA6B)
+        x = x ^ (x >> 13)
+        x = x * jnp.uint32(0xC2B2AE35)
+        x = x ^ (x >> 16)
+        ids = (x % jnp.uint32(nparts)).astype(jnp.int32)
+        ids_ref[:] = ids
+
+        # Per-block histogram: compare against a lane iota and reduce
+        # over the block's rows/lanes.
+        pid = jax.lax.broadcasted_iota(
+            jnp.int32, (1, hist_lanes), dimension=1
+        )
+        onehot = (ids.reshape(-1, 1) == pid.reshape(1, -1)).astype(
+            jnp.int32
+        )
+        local = jnp.sum(onehot, axis=0, keepdims=True)
+
+        @pl.when(step == 0)
+        def _init():
+            counts_ref[:] = jnp.zeros_like(counts_ref)
+
+        counts_ref[:] += local
+
+    def run(keys2d):
+        rows = keys2d.shape[0]
+        grid = (rows // block_rows,)
+        return pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((block_rows, LANES), lambda i: (i, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((block_rows, LANES), lambda i: (i, 0)),
+                # Same accumulator block revisited every step.
+                pl.BlockSpec((1, hist_lanes), lambda i: (0, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((rows, LANES), np.int32),
+                jax.ShapeDtypeStruct((1, hist_lanes), np.int32),
+            ],
+            interpret=interpret,
+        )(keys2d)
+
+    return jax.jit(run)
+
+
+def hash_partition(keys, nparts: int, seed: int = 0,
+                   block_rows: int = 8) -> Tuple:
+    """Fused hash+partition+histogram over an int32 key column.
+
+    Returns (ids int32[n], counts int32[nparts]). Bit-identical to
+    ``frame_ops.hash_device_column(keys, seed) % nparts`` + bincount.
+    Rows are padded to a (block_rows, 128) grid; padding rows are
+    excluded from the histogram by the caller-visible contract (we
+    subtract them from their bucket).
+    """
+    import jax.numpy as jnp
+
+    from bigslice_tpu.frame import ops as frame_ops
+
+    keys = jnp.asarray(keys)
+    n = keys.shape[0]
+    if n == 0:
+        # grid=(0,) would skip the accumulator init entirely, returning
+        # uninitialized counts on real hardware.
+        return (jnp.zeros((0,), jnp.int32),
+                jnp.zeros((nparts,), jnp.int32))
+    per_block = block_rows * LANES
+    padded = ((n + per_block - 1) // per_block) * per_block
+    npad = padded - n
+    flat = jnp.concatenate(
+        [keys.astype(jnp.int32), jnp.zeros((npad,), jnp.int32)]
+    )
+    keys2d = flat.reshape(-1, LANES)
+    fn = _build_hash_partition(
+        nparts, block_rows, int(frame_ops._seed32(seed)), _interpret()
+    )
+    ids2d, counts = fn(keys2d)
+    ids = ids2d.reshape(-1)[:n]
+    counts = counts.reshape(-1)[:nparts]
+    if npad:
+        # Padding zeros all hashed into one known bucket; remove them.
+        zero_hash = frame_ops.fmix32(
+            np.zeros(1, np.uint32) ^ frame_ops._seed32(seed)
+        )
+        pad_bucket = int(zero_hash[0] % np.uint32(nparts))
+        counts = counts.at[pad_bucket].add(-npad)
+    return ids, counts

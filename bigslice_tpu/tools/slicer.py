@@ -1,0 +1,119 @@
+"""Stress/integration runner (cmd/slicer analog).
+
+Mirrors cmd/slicer/main.go:20-36: named stress scenarios exercising the
+system at configurable scale — cogroup, reduce, iterative memory
+(leak check via repeated Result reuse), and a big-shuffle soak.
+
+Usage:
+    python -m bigslice_tpu.tools.slicer [-local] MODE [-rows N] [-shards S]
+Modes: reduce | cogroup | memiter | shuffle
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+
+def _data(rows: int, key_range: int, seed: int = 0):
+    rng = np.random.RandomState(seed)
+    return (rng.randint(0, key_range, rows).astype(np.int32),
+            rng.randint(0, 100, rows).astype(np.int32))
+
+
+def run_reduce(sess, rows, shards):
+    import bigslice_tpu as bs
+
+    keys, vals = _data(rows, max(1, rows // 100))
+    res = sess.run(bs.Reduce(bs.Const(shards, keys, vals),
+                             lambda a, b: a + b))
+    total = sum(v for _, v in res.rows())
+    assert total == int(vals.sum()), (total, int(vals.sum()))
+    return total
+
+
+def run_cogroup(sess, rows, shards):
+    import bigslice_tpu as bs
+
+    k1, v1 = _data(rows, max(1, rows // 50), seed=1)
+    k2, v2 = _data(rows, max(1, rows // 50), seed=2)
+    res = sess.run(bs.Cogroup(bs.Const(shards, k1, v1),
+                              bs.Const(shards, k2, v2)))
+    n = sum(len(a) + len(b) for _, a, b in res.rows())
+    assert n == 2 * rows, (n, rows)
+    return n
+
+
+def _ident(k, v):
+    return (k, v)
+
+
+def _add(a, b):
+    return a + b
+
+
+def run_memiter(sess, rows, shards, iters: int = 20):
+    """Repeated Result-reusing runs; per-iteration RSS growth indicates a
+    task/store leak (cmd/slicer memiter analog).
+
+    Uses module-level functions (the documented iterative pattern): fresh
+    lambdas per iteration would measure jit-cache churn, not framework
+    leaks.
+    """
+    import resource
+
+    import bigslice_tpu as bs
+
+    keys, vals = _data(rows, 997)
+    base = sess.run(bs.Const(shards, keys, vals))
+    rss = []
+    for i in range(iters):
+        res = sess.run(bs.Reduce(bs.Map(base, _ident), _add))
+        res.discard()
+        rss.append(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss)
+    return rss[0], rss[-1]
+
+
+def run_shuffle(sess, rows, shards):
+    import bigslice_tpu as bs
+
+    keys, _ = _data(rows, rows)
+    res = sess.run(bs.Reshuffle(bs.Const(shards, keys)))
+    n = sum(1 for _ in res.rows())
+    assert n == rows
+    return n
+
+
+MODES = {
+    "reduce": run_reduce,
+    "cogroup": run_cogroup,
+    "memiter": run_memiter,
+    "shuffle": run_shuffle,
+}
+
+
+def main(argv=None) -> int:
+    from bigslice_tpu import sliceconfig
+
+    argv = argv if argv is not None else sys.argv[1:]
+    sess, rest = sliceconfig.parse(argv)
+    ap = argparse.ArgumentParser(prog="slicer")
+    ap.add_argument("mode", choices=sorted(MODES))
+    ap.add_argument("-rows", type=int, default=100_000)
+    ap.add_argument("-shards", type=int, default=8)
+    args = ap.parse_args(rest)
+    t0 = time.perf_counter()
+    out = MODES[args.mode](sess, args.rows, args.shards)
+    dt = time.perf_counter() - t0
+    print(f"slicer {args.mode}: rows={args.rows} shards={args.shards} "
+          f"-> {out} in {dt:.2f}s "
+          f"({args.rows / max(dt, 1e-9):,.0f} rows/s)")
+    sess.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,95 @@
+"""Static pipeline checker (analysis/typecheck analog).
+
+Mirrors the reference's golang.org/x/tools analyzer
+(analysis/typecheck/typecheck.go:15-143): scan Python sources for
+``session.run(func, args...)`` calls and check them against ``@func``
+definitions found in the same files — arity mismatches surface before
+anything runs. (The reference additionally checks Func-arg gob
+serializability; in the SPMD model arguments never cross a process
+boundary by value, so there is no serializability constraint.)
+
+Usage: python -m bigslice_tpu.tools.slicetypecheck FILE [FILE...]
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from typing import Dict, List, Tuple
+
+
+class _Collector(ast.NodeVisitor):
+    def __init__(self):
+        self.funcs: Dict[str, Tuple[int, int, bool]] = {}
+        self.calls: List[Tuple[str, int, int]] = []  # name, nargs, lineno
+
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        for dec in node.decorator_list:
+            name = None
+            if isinstance(dec, ast.Attribute):
+                name = dec.attr
+            elif isinstance(dec, ast.Name):
+                name = dec.id
+            elif isinstance(dec, ast.Call):
+                f = dec.func
+                name = f.attr if isinstance(f, ast.Attribute) else getattr(
+                    f, "id", None
+                )
+            if name == "func":
+                required = len(node.args.args) - len(node.args.defaults)
+                has_var = node.args.vararg is not None
+                self.funcs[node.name] = (
+                    required, len(node.args.args), has_var
+                )
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        f = node.func
+        if (isinstance(f, ast.Attribute) and f.attr in ("run", "must")
+                and node.args):
+            target = node.args[0]
+            if isinstance(target, ast.Name):
+                self.calls.append(
+                    (target.id, len(node.args) - 1, node.lineno)
+                )
+        self.generic_visit(node)
+
+
+def check_source(src: str, filename: str = "<src>") -> List[str]:
+    tree = ast.parse(src, filename)
+    c = _Collector()
+    c.visit(tree)
+    problems = []
+    for name, nargs, lineno in c.calls:
+        sig = c.funcs.get(name)
+        if sig is None:
+            continue  # not a registered Func we can see
+        required, total, has_var = sig
+        if nargs < required or (nargs > total and not has_var):
+            problems.append(
+                f"{filename}:{lineno}: run({name}, ...) passes {nargs} "
+                f"args; {name} takes "
+                + (f"at least {required}" if has_var
+                   else f"{required}" if required == total
+                   else f"{required}..{total}")
+            )
+    return problems
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    if not argv:
+        print("usage: python -m bigslice_tpu.tools.slicetypecheck "
+              "FILE [FILE...]", file=sys.stderr)
+        return 2
+    bad = 0
+    for path in argv:
+        with open(path) as fp:
+            for p in check_source(fp.read(), path):
+                print(p)
+                bad += 1
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

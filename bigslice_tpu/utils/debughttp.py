@@ -1,0 +1,96 @@
+"""Debug HTTP server: live status, task DAG, trace download.
+
+Mirrors the reference's debug endpoints (exec/graph.go:15-100,
+exec/session.go:376-389): ``/debug`` (index), ``/debug/status`` (live
+per-op task counts), ``/debug/tasks`` (task DAG as JSON, the d3
+force-graph data source), ``/debug/trace`` (Chrome trace JSON of the
+session so far).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+
+
+class DebugServer:
+    def __init__(self, session, port: int = 0):
+        self.session = session
+        self._roots: List = []
+        self._lock = threading.Lock()
+
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def do_GET(self):
+                if self.path in ("/debug", "/debug/"):
+                    body = (
+                        "bigslice_tpu debug\n\n"
+                        "/debug/status  live task-state counts\n"
+                        "/debug/tasks   task DAG (json)\n"
+                        "/debug/trace   chrome trace (json)\n"
+                    )
+                    self._send(200, "text/plain", body)
+                elif self.path == "/debug/status":
+                    self._send(200, "text/plain",
+                               server.session.status.render() or "(idle)")
+                elif self.path == "/debug/tasks":
+                    self._send(200, "application/json",
+                               json.dumps(server.task_graph()))
+                elif self.path == "/debug/trace":
+                    tracer = server.session.tracer
+                    events = tracer.events() if tracer else []
+                    self._send(200, "application/json",
+                               json.dumps({"traceEvents": events}))
+                else:
+                    self._send(404, "text/plain", "not found\n")
+
+            def _send(self, code, ctype, body: str):
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self.httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def register_roots(self, roots) -> None:
+        with self._lock:
+            self._roots.extend(roots)
+
+    def task_graph(self) -> dict:
+        from bigslice_tpu.exec.task import iter_tasks
+
+        with self._lock:
+            roots = list(self._roots)
+        nodes, links = [], []
+        for t in iter_tasks(roots):
+            nodes.append({
+                "id": str(t.name),
+                "op": t.name.op,
+                "shard": t.name.shard,
+                "state": t.state.name,
+            })
+            for d in t.deps:
+                for p in d.tasks:
+                    links.append({
+                        "source": str(p.name),
+                        "target": str(t.name),
+                        "partition": d.partition,
+                    })
+        return {"nodes": nodes, "links": links}
+
+    def close(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()

@@ -42,6 +42,12 @@ def initialize(coordinator: Optional[str] = None,
         kwargs["process_id"] = process_id
     jax.distributed.initialize(**kwargs)
     _initialized = True
+    # Fail fast on Func-registry drift between hosts (the reference's
+    # FuncLocations verification at machine start,
+    # exec/slicemachine.go:665-728).
+    from bigslice_tpu.ops.func import verify_registry_across_hosts
+
+    verify_registry_across_hosts()
 
 
 def is_coordinator() -> bool:

@@ -157,3 +157,68 @@ def test_topn():
         t.add(score, item)
     assert [it for _, it in t.items()] == ["c", "d", "a"]
     assert topn.top_n([(1, "x"), (2, "y")], 1) == [(2, "y")]
+
+
+def test_debug_http_endpoints():
+    import urllib.request
+
+    sess = Session(debug_port=0, trace_path="/tmp/unused-trace.json")
+    sess.run(bs.Const(3, np.arange(6, dtype=np.int32)))
+    port = sess.debug.port
+    def get(path):
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}"
+        ) as r:
+            return r.read().decode()
+    assert "3/3 done" in get("/debug/status")
+    doc = json.loads(get("/debug/tasks"))
+    assert len(doc["nodes"]) == 3
+    assert all(n["state"] == "OK" for n in doc["nodes"])
+    trace = json.loads(get("/debug/trace"))
+    assert len([e for e in trace["traceEvents"] if e["ph"] == "X"]) == 3
+    import urllib.error
+    with pytest.raises(urllib.error.HTTPError):
+        get("/nope")
+    sess.shutdown()
+
+
+def test_slicetypecheck_tool():
+    from bigslice_tpu.tools import slicetypecheck as stc
+
+    src = (
+        "import bigslice_tpu as bs\n"
+        "@bs.func\n"
+        "def pipe(a, b, c=1):\n"
+        "    return None\n"
+        "sess.run(pipe, 1)\n"          # too few
+        "sess.run(pipe, 1, 2)\n"       # ok
+        "sess.run(pipe, 1, 2, 3)\n"    # ok
+        "sess.run(pipe, 1, 2, 3, 4)\n"  # too many
+    )
+    problems = stc.check_source(src, "x.py")
+    assert len(problems) == 2
+    assert "x.py:5" in problems[0] and "x.py:8" in problems[1]
+
+
+def test_slicer_tool(tmp_path, monkeypatch, capsys):
+    from bigslice_tpu import sliceconfig
+    from bigslice_tpu.tools import slicer
+
+    monkeypatch.setattr(sliceconfig, "CONFIG_PATH", str(tmp_path / "no"))
+    assert slicer.main(["-local", "reduce", "-rows", "2000",
+                        "-shards", "4"]) == 0
+    assert "slicer reduce" in capsys.readouterr().out
+
+
+def test_registry_digest_stable():
+    from bigslice_tpu.ops import func as func_mod
+
+    d1 = func_mod.registry_digest()
+    d2 = func_mod.registry_digest()
+    assert d1 == d2 and len(d1) == 64
+
+    @bs.func
+    def _another():
+        return bs.Const(1, [1])
+
+    assert func_mod.registry_digest() != d1

@@ -327,3 +327,28 @@ def test_reduce_float64_ndarray_keys(sess):
     r = bs.Reduce(bs.Const(2, keys, vals), lambda a, b: a + b)
     rows = slicetest.sorted_rows(r, session=sess)
     assert rows == [(1.5, 2), (2.5, 1), (3.5, 1)]
+
+
+def test_machine_combiners():
+    """MachineCombiners: one shared combine per process instead of one
+    per producer task (exec/session.go:166-176 analog)."""
+    rng = np.random.RandomState(3)
+    keys = rng.randint(0, 30, 600).astype(np.int32)
+    vals = rng.randint(0, 5, 600).astype(np.int32)
+    sess = Session(machine_combiners=True)
+    r = bs.Reduce(bs.Const(6, keys, vals), lambda a, b: a + b)
+    res = sess.run(r)
+    oracle = {}
+    for k, v in zip(keys.tolist(), vals.tolist()):
+        oracle[k] = oracle.get(k, 0) + v
+    assert dict(res.rows()) == oracle
+    # The shared combiner actually committed buffers.
+    assert sess.executor._mc_committed
+
+
+def test_machine_combiners_host_keys():
+    sess = Session(machine_combiners=True)
+    words = ["x", "y", "x", "z"] * 25
+    r = bs.Reduce(bs.Const(4, words, np.ones(100, dtype=np.int32)),
+                  lambda a, b: a + b)
+    assert dict(sess.run(r).rows()) == {"x": 50, "y": 25, "z": 25}
