@@ -155,10 +155,7 @@ class Compiler:
                     deps.append(
                         TaskDep(
                             tuple(dep_tasks), shard, expand=dep.expand,
-                            combine_key=(
-                                dep_tasks[0].partitioner.combine_key
-                                if dep_tasks else ""
-                            ),
+                            combine_key=dep_part.combine_key,
                         )
                     )
                 else:

@@ -27,11 +27,16 @@ from bigslice_tpu.utils import metrics as metrics_mod
 
 
 class DepLost(Exception):
-    """A dependency's stored output is gone; carries the producer task so
-    it can be marked LOST and re-evaluated."""
+    """A dependency's stored output is gone; carries the producer task(s)
+    to mark LOST for re-evaluation. Machine-combined deps lose the whole
+    producer group (contributions are freed at commit, so recovery needs
+    every shard to recontribute)."""
 
-    def __init__(self, producer):
+    def __init__(self, producer, all_producers=None):
         self.producer = producer
+        self.producers = tuple(all_producers) if all_producers else (
+            producer,
+        )
         super().__init__(f"lost output of {producer.name}")
 
 
@@ -131,7 +136,7 @@ class LocalExecutor:
                         (dep.combine_key, dep.partition)
                     )
                 if not committed:
-                    raise DepLost(dep.tasks[0])
+                    raise DepLost(dep.tasks[0], all_producers=dep.tasks)
                 if frame is None or not len(frame):
                     return sliceio.empty_reader()
                 return iter([frame])
@@ -168,10 +173,11 @@ class LocalExecutor:
                 self._execute(task)
             task.mark_ok()
         except DepLost as e:
-            # A dependency's output vanished: this run is lost, and so is
-            # the producing task — the evaluator re-runs the producer
+            # A dependency's output vanished: this run is lost, and so are
+            # the producing task(s) — the evaluator re-runs producers
             # before resubmitting us (exec/slicemachine.go:148-227 analog).
-            e.producer.mark_lost(e)
+            for p in e.producers:
+                p.mark_lost(e)
             task.mark_lost(e)
         except Exception as e:  # noqa: BLE001 — app errors are fatal
             task.set_state(TaskState.ERR, e)

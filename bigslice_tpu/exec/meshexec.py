@@ -247,7 +247,8 @@ class MeshExecutor:
             for t in tasks:
                 t.mark_ok()
         except DepLost as e:
-            e.producer.mark_lost(e)
+            for p in e.producers:
+                p.mark_lost(e)
             for t in tasks:
                 t.mark_lost(e)
         except Exception as e:  # noqa: BLE001

@@ -130,8 +130,11 @@ def verify_registry_across_hosts() -> None:
 
     digest = registry_digest()
     local = np.frombuffer(bytes.fromhex(digest), dtype=np.uint8)
-    global_ = multihost_utils.broadcast_one_to_all(local)
-    if not np.array_equal(local, np.asarray(global_)):
+    # All-gather (not broadcast): every host — including process 0 —
+    # must see the mismatch, or the coordinator sails on and deadlocks
+    # at its next collective while the drifted host raises.
+    all_digests = np.asarray(multihost_utils.process_allgather(local))
+    if not (all_digests == local[None, :]).all():
         raise RuntimeError(
             "bigslice_tpu Func registry differs between hosts: "
             "ensure every process registers the same @func definitions "

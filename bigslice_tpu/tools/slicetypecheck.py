@@ -49,9 +49,11 @@ class _Collector(ast.NodeVisitor):
                 and node.args):
             target = node.args[0]
             if isinstance(target, ast.Name):
-                self.calls.append(
-                    (target.id, len(node.args) - 1, node.lineno)
-                )
+                self.calls.append((
+                    target.id,
+                    len(node.args) - 1 + len(node.keywords),
+                    node.lineno,
+                ))
         self.generic_visit(node)
 
 

@@ -352,3 +352,17 @@ def test_machine_combiners_host_keys():
     r = bs.Reduce(bs.Const(4, words, np.ones(100, dtype=np.int32)),
                   lambda a, b: a + b)
     assert dict(sess.run(r).rows()) == {"x": 50, "y": 25, "z": 25}
+
+
+def test_machine_combiners_discard_recovers():
+    """Regression: discarding a machine-combined result and re-reading
+    must recompute the whole producer group (contributions are freed at
+    commit, so recovery marks every producer lost, not just one)."""
+    sess = Session(machine_combiners=True)
+    keys = np.arange(120, dtype=np.int32) % 7
+    r = bs.Reduce(bs.Const(6, keys, np.ones(120, dtype=np.int32)),
+                  lambda a, b: a + b)
+    res = sess.run(r)
+    first = dict(res.rows())
+    res.discard()
+    assert dict(res.rows()) == first
