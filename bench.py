@@ -97,9 +97,82 @@ def device_bench(keys: np.ndarray, vals: np.ndarray, iters: int = 5):
     return (n * per) / best, int(np.asarray(out_counts).sum())
 
 
+def join_bench(n_rows: int, iters: int = 3):
+    """rows/sec for the device join (reduce both sides + align): the
+    BASELINE Reduce+Cogroup headline shape."""
+    import jax
+    from jax.sharding import Mesh
+
+    from bigslice_tpu.parallel import join as join_mod
+    from bigslice_tpu.parallel import shuffle as shuffle_mod
+
+    devs = jax.devices()
+    n = len(devs)
+    mesh = Mesh(np.array(devs), ("shards",))
+    per = n_rows // n
+    nkeys = max(16, n_rows // 16)
+
+    def side(seed):
+        r = np.random.RandomState(seed)
+        kc = [r.randint(0, nkeys, per).astype(np.int32)
+              for _ in range(n)]
+        vc = [np.ones(per, np.int32) for _ in range(n)]
+        return shuffle_mod.shard_columns(mesh, [kc, vc], [per] * n, per)
+
+    a_cols, a_counts = side(1)
+    b_cols, b_counts = side(2)
+    j = join_mod.MeshJoinAggregate(
+        mesh, per, lambda x, y: x + y, lambda x, y: x + y
+    )
+
+    def run_once():
+        out = j(a_cols, a_counts, b_cols, b_counts)
+        jax.block_until_ready(out[0])
+        return out
+
+    out = run_once()  # warm
+    if int(np.asarray(out[4])) != 0:
+        print("warning: join shuffle overflow — throughput excludes "
+              "dropped rows", file=sys.stderr)
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        run_once()
+        times.append(time.perf_counter() - t0)
+    return (2 * n * per) / min(times)
+
+
+def cpu_join_baseline(n_rows: int) -> float:
+    rng1 = np.random.RandomState(1)
+    rng2 = np.random.RandomState(2)
+    nkeys = max(16, n_rows // 16)
+    a = rng1.randint(0, nkeys, n_rows).astype(np.int32)
+    b = rng2.randint(0, nkeys, n_rows).astype(np.int32)
+    t0 = time.perf_counter()
+    ka, ca = np.unique(a, return_counts=True)
+    kb, cb = np.unique(b, return_counts=True)
+    np.intersect1d(ka, kb, assume_unique=True)
+    return 2 * n_rows / (time.perf_counter() - t0)
+
+
 def main():
     _ensure_usable_backend()
-    n_rows = int(sys.argv[1]) if len(sys.argv) > 1 else 1 << 24  # 16.7M
+    mode = "reduce"
+    args = sys.argv[1:]
+    if args and args[0] in ("reduce", "join"):
+        mode = args.pop(0)
+    if mode == "join":
+        n_rows = int(args[0]) if args else 1 << 23
+        dev = join_bench(n_rows)
+        base = cpu_join_baseline(n_rows)
+        print(json.dumps({
+            "metric": "join_aggregate_rows_per_sec",
+            "value": round(dev, 1),
+            "unit": "rows/sec",
+            "vs_baseline": round(dev / base, 3),
+        }))
+        return
+    n_rows = int(args[0]) if args else 1 << 24  # 16.7M
     n_keys = 1 << 16
     rng = np.random.RandomState(42)
     keys = rng.randint(0, n_keys, n_rows).astype(np.int32)

@@ -40,7 +40,7 @@ from bigslice_tpu.ops.base import (
 from bigslice_tpu.ops.func import Func, func, Invocation
 from bigslice_tpu.ops.const import Const
 from bigslice_tpu.ops.source import ReaderFunc, WriterFunc, ScanReader
-from bigslice_tpu.ops.mapops import Map, Filter, Flatmap, Head, Scan, Prefixed, Unwrap
+from bigslice_tpu.ops.mapops import Map, MapBatches, Filter, Flatmap, Head, Scan, Prefixed, Unwrap
 from bigslice_tpu.ops.reduce import Reduce
 from bigslice_tpu.ops.fold import Fold
 from bigslice_tpu.ops.cogroup import Cogroup
@@ -65,6 +65,7 @@ __all__ = [
     "WriterFunc",
     "ScanReader",
     "Map",
+    "MapBatches",
     "Filter",
     "Flatmap",
     "Head",

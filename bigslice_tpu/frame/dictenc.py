@@ -1,0 +1,127 @@
+"""Dictionary encoding: host payloads as device surrogate keys.
+
+SURVEY.md §7.3(2)'s strategy for variable-width data on TPU: strings (or
+any hashable host payloads) are mapped to dense int32 codes against a
+vocabulary; the codes ride the device tier (hash, shuffle, sort, segment
+reduce — all on-chip), and the vocabulary rejoins payloads at the edges.
+
+Layers:
+- ``encode_column`` / ``decode_column``: one-shot column encoding with a
+  local (first-seen) vocabulary.
+- ``GlobalVocab`` + ``encode_frame_column``/``decode_frame_column``:
+  a shared vocabulary for *cross-shard* keyed work — build once on the
+  host, encode anywhere, decode at the edges.
+- ``dict_encoded_reduce``: the end-to-end pattern — encode via a
+  vectorized ``MapBatches``, Reduce on the device tier, decode on
+  read-back.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+from bigslice_tpu.frame.frame import Frame, obj_col
+from bigslice_tpu.slicetype import ColType, Schema
+
+
+def encode_column(col: Sequence) -> Tuple[np.ndarray, List]:
+    """Encode host values to dense int32 codes + vocabulary (first-seen
+    order)."""
+    vocab: Dict = {}
+    codes = np.empty(len(col), dtype=np.int32)
+    for i, v in enumerate(col):
+        code = vocab.get(v)
+        if code is None:
+            code = len(vocab)
+            vocab[v] = code
+        codes[i] = code
+    return codes, list(vocab)
+
+
+def decode_column(codes, vocab: Sequence) -> np.ndarray:
+    lookup = np.empty(len(vocab), dtype=object)
+    lookup[:] = list(vocab)
+    return lookup[np.asarray(codes)]
+
+
+class GlobalVocab:
+    """A shared vocabulary for cross-shard encoded work: build once on
+    the host (or incrementally), encode anywhere, decode at the edges."""
+
+    def __init__(self, values: Sequence = ()):
+        self._index: Dict = {}
+        self._values: List = []
+        self._lookup = None  # cached decode array
+        self.extend(values)
+
+    def extend(self, values: Sequence) -> None:
+        for v in values:
+            if v not in self._index:
+                self._index[v] = len(self._values)
+                self._values.append(v)
+        self._lookup = None
+
+    def __len__(self) -> int:
+        return len(self._values)
+
+    def encode(self, col: Sequence) -> np.ndarray:
+        idx = self._index
+        out = np.empty(len(col), dtype=np.int32)
+        for i, v in enumerate(col):
+            code = idx.get(v)
+            if code is None:
+                raise KeyError(f"value {v!r} not in vocabulary")
+            out[i] = code
+        return out
+
+    def decode(self, codes) -> np.ndarray:
+        if self._lookup is None:
+            self._lookup = np.empty(len(self._values), dtype=object)
+            self._lookup[:] = self._values
+        return self._lookup[np.asarray(codes)]
+
+
+def encode_frame_column(frame: Frame, col_index: int,
+                        vocab: GlobalVocab) -> Frame:
+    """Replace a host column with its int32 codes (schema updates to a
+    device column)."""
+    cols = list(frame.cols)
+    cols[col_index] = vocab.encode(cols[col_index])
+    types = list(frame.schema.cols)
+    types[col_index] = ColType(np.dtype(np.int32))
+    return Frame(cols, Schema(types, frame.schema.prefix))
+
+
+def decode_frame_column(frame: Frame, col_index: int,
+                        vocab: GlobalVocab, tag: str = "str") -> Frame:
+    cols = list(frame.cols)
+    cols[col_index] = obj_col(list(vocab.decode(cols[col_index])))
+    types = list(frame.schema.cols)
+    types[col_index] = ColType(np.dtype(object), tag)
+    return Frame(cols, Schema(types, frame.schema.prefix))
+
+
+def dict_encoded_reduce(sess, slice_, combine_fn, vocab: GlobalVocab):
+    """Reduce a (host_key, *device_vals) slice entirely on the device
+    tier: encode keys to codes, shuffle/combine on device, decode on
+    read-back. Returns decoded rows.
+
+    The recommended pattern for string-keyed reduces at scale (wordcount
+    with a bounded dictionary): the host pays one encode pass; the hash,
+    shuffle, and segmented combine all run on-chip.
+    """
+    import bigslice_tpu as bs
+
+    encoded = bs.MapBatches(
+        slice_,
+        lambda f: [vocab.encode(f.cols[0])] + list(f.cols[1:]),
+        out=[np.int32] + [c for c in slice_.schema.cols[1:]],
+    )
+    res = sess.run(bs.Reduce(encoded, combine_fn))
+    out = []
+    for f in res.frames():
+        f = decode_frame_column(f.to_host(), 0, vocab)
+        out.extend(f.rows())
+    return out

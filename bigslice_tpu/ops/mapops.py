@@ -167,6 +167,49 @@ class Map(_Pipelined):
         return read()
 
 
+class MapBatches(_Pipelined):
+    """Batch-level host transform: ``fn(frame) -> frame-like`` applied to
+    whole columnar batches (vectorized numpy on the host tier).
+
+    The reference's per-record surface has no analog; this is the natural
+    escape hatch for host work that vectorizes (dictionary encoding,
+    string ops over whole columns) without per-row Python dispatch.
+    ``out`` declares the output schema; fn may return a Frame or a tuple
+    of columns.
+    """
+
+    def __init__(self, slice_: Slice, fn: Callable, out):
+        super().__init__(slice_, _as_schema(out), make_name("mapbatches"))
+        self.fn = fn
+
+    def reader(self, shard, deps):
+        def read():
+            for f in deps[0]():
+                if not len(f):
+                    continue
+                o = self.fn(f)
+                cols = list(o.cols) if isinstance(o, Frame) else list(o)
+                yield Frame(_conform(cols, self.schema), self.schema)
+
+        return read()
+
+
+def _conform(cols, schema):
+    """Coerce device columns to the declared dtypes so the frame schema
+    never lies about its columns (the invariant Map's jax path enforces
+    by casting)."""
+    out = []
+    for c, ct in zip(cols, schema):
+        if ct.is_device:
+            a = np.asarray(c)
+            if a.dtype != ct.dtype:
+                a = a.astype(ct.dtype)
+            out.append(a)
+        else:
+            out.append(c)
+    return out
+
+
 class Filter(_Pipelined):
     """Predicate filter (mirrors bigslice.Filter, slice.go:657-726)."""
 
@@ -211,17 +254,81 @@ class Filter(_Pipelined):
 class Flatmap(_Pipelined):
     """1→N transform (mirrors bigslice.Flatmap, slice.go:745-841).
 
-    ``fn(*row)`` yields output rows (any iterable of tuples). Host-tier:
-    variable fan-out is inherently dynamic-shaped; a fixed-fanout device
-    variant can be layered on later without changing the API.
+    Two modes:
+    - **host** (default): ``fn(*row)`` yields output rows (any iterable
+      of tuples) — arbitrary, dynamic fan-out on the host tier.
+    - **device** (``fanout=k``): ``fn(*row) -> (mask, col0, col1, ...)``
+      where ``mask`` is bool[k] selecting valid outputs and each column
+      is a [k]-shaped array — the XLA-compatible fixed-capacity shape
+      for data-dependent fan-out (SURVEY.md §7.3(1) pad/overflow
+      strategy). The vmapped fn produces [n, k] planes which flatten and
+      compact columnar-ly, never per row.
     """
 
-    def __init__(self, slice_: Slice, fn: Callable, out):
+    def __init__(self, slice_: Slice, fn: Callable, out,
+                 fanout: Optional[int] = None):
         name = make_name("flatmap")
         self.fn = fn
-        super().__init__(slice_, _as_schema(out), name)
+        self.fanout = fanout
+        schema = _as_schema(out)
+        if fanout is not None:
+            typecheck.check(fanout >= 1, "flatmap: fanout must be >= 1")
+            typecheck.check(
+                all(ct.is_device for ct in schema),
+                "flatmap: fixed-fanout mode requires device column types",
+            )
+            if not all(ct.is_device for ct in slice_.schema):
+                raise typecheck.errorf(
+                    "flatmap: fixed-fanout mode requires device inputs"
+                )
+            self._check_fixed_trace(slice_, fn, schema, fanout)
+            self._vfn = get_padded_vmap(fn)
+            self.mode = "jax"
+        else:
+            self.mode = "host"
+        super().__init__(slice_, schema, name)
+
+    @staticmethod
+    def _check_fixed_trace(slice_, fn, schema, fanout):
+        """Construction-time shape/traceability check (matches Map's
+        altitude: clear errors at the call site, not mid-run in vmap)."""
+        try:
+            import jax
+
+            specs = [jax.ShapeDtypeStruct((), ct.dtype)
+                     for ct in slice_.schema]
+            out = jax.eval_shape(fn, *specs)
+        except Exception as e:
+            raise typecheck.errorf(
+                "flatmap: fixed-fanout function is not jax-traceable "
+                "over %s (%s)", slice_.schema, e,
+            )
+        if not isinstance(out, (tuple, list)) or len(out) != 1 + len(schema):
+            raise typecheck.errorf(
+                "flatmap: fixed-fanout function must return (mask, %d "
+                "columns), got %d outputs",
+                len(schema),
+                len(out) if isinstance(out, (tuple, list)) else 1,
+            )
+        for i, o in enumerate(out):
+            if tuple(o.shape) != (fanout,):
+                raise typecheck.errorf(
+                    "flatmap: output %d has shape %s, want (%d,) — every "
+                    "output (including the mask) must be fanout-wide",
+                    i, tuple(o.shape), fanout,
+                )
+        if np.dtype(out[0].dtype) != np.dtype(np.bool_):
+            raise typecheck.errorf(
+                "flatmap: first output must be a bool mask, got %s",
+                out[0].dtype,
+            )
 
     def reader(self, shard, deps):
+        if self.mode == "jax":
+            return self._read_fixed(deps)
+        return self._read_host(deps)
+
+    def _read_host(self, deps):
         def read():
             pending = []
             npending = 0
@@ -235,6 +342,21 @@ class Flatmap(_Pipelined):
                         pending, npending = [], 0
             if pending:
                 yield Frame.from_rows(pending, self.schema)
+
+        return read()
+
+    def _read_fixed(self, deps):
+        def read():
+            for f in deps[0]():
+                if not len(f):
+                    continue
+                outs, n = self._vfn(f.cols, len(f))
+                mask = np.asarray(outs[0]).reshape(-1)
+                cols = [np.asarray(o).reshape(-1) for o in outs[1:]]
+                idx = np.flatnonzero(mask)
+                if len(idx):
+                    yield Frame(_conform([c[idx] for c in cols],
+                                         self.schema), self.schema)
 
         return read()
 

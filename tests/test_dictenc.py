@@ -1,0 +1,73 @@
+"""Dictionary-encoding tests: host payloads riding the device tier as
+surrogate keys (SURVEY.md §7.3(2))."""
+
+import numpy as np
+import pytest
+
+import bigslice_tpu as bs
+from bigslice_tpu import slicetest
+from bigslice_tpu.exec.session import Session
+from bigslice_tpu.frame import dictenc
+from bigslice_tpu.frame.frame import Frame
+
+
+def test_encode_decode_roundtrip():
+    col = ["b", "a", "b", "c", "a"]
+    codes, vocab = dictenc.encode_column(col)
+    assert codes.dtype == np.int32
+    assert vocab == ["b", "a", "c"]
+    assert list(dictenc.decode_column(codes, vocab)) == col
+
+
+def test_global_vocab():
+    v = dictenc.GlobalVocab(["x", "y"])
+    v.extend(["z", "x"])
+    assert len(v) == 3
+    codes = v.encode(["z", "x", "y"])
+    assert list(v.decode(codes)) == ["z", "x", "y"]
+    with pytest.raises(KeyError):
+        v.encode(["nope"])
+
+
+def test_encode_frame_column_roundtrip():
+    v = dictenc.GlobalVocab(["a", "b"])
+    f = Frame([["a", "b", "a"], np.arange(3, dtype=np.int32)])
+    enc = dictenc.encode_frame_column(f, 0, v)
+    assert enc.schema[0].is_device
+    dec = dictenc.decode_frame_column(enc, 0, v)
+    assert dec == f.to_host()
+
+
+def test_mapbatches():
+    s = bs.Const(2, ["aa", "b", "ccc"], np.arange(3, dtype=np.int32))
+    m = bs.MapBatches(
+        s,
+        lambda f: [np.asarray([len(x) for x in f.cols[0]], np.int32),
+                   f.cols[1]],
+        out=[np.int32, np.int32],
+    )
+    assert slicetest.sorted_rows(m) == [(1, 1), (2, 0), (3, 2)]
+
+
+def test_dict_encoded_reduce_device_path():
+    words = ["the", "fox", "the", "dog", "fox", "the"] * 50
+    vocab = dictenc.GlobalVocab(sorted(set(words)))
+    sess = Session()
+    s = bs.Const(4, words, np.ones(len(words), dtype=np.int32))
+    rows = dictenc.dict_encoded_reduce(sess, s, lambda a, b: a + b, vocab)
+    assert sorted(rows) == [("dog", 50), ("fox", 100), ("the", 150)]
+
+
+def test_dict_encoded_reduce_on_mesh():
+    import jax
+    from jax.sharding import Mesh
+
+    from bigslice_tpu.exec.meshexec import MeshExecutor
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("shards",))
+    sess = Session(executor=MeshExecutor(mesh))
+    words = ["a", "b", "c", "d"] * 80
+    vocab = dictenc.GlobalVocab(sorted(set(words)))
+    s = bs.Const(8, words, np.ones(len(words), dtype=np.int32))
+    rows = dictenc.dict_encoded_reduce(sess, s, lambda a, b: a + b, vocab)
+    assert sorted(rows) == [("a", 80), ("b", 80), ("c", 80), ("d", 80)]

@@ -366,3 +366,39 @@ def test_machine_combiners_discard_recovers():
     first = dict(res.rows())
     res.discard()
     assert dict(res.rows()) == first
+
+
+def test_flatmap_fixed_fanout_device(sess):
+    """Device-tier Flatmap with static fanout + validity mask."""
+    import jax.numpy as jnp
+
+    def expand(x):
+        # emit x and x+100; second slot only when x is even
+        vals = jnp.stack([x, x + 100])
+        mask = jnp.array([True, False]) | (x % 2 == 0)
+        return mask, vals
+
+    s = bs.Const(2, np.arange(10, dtype=np.int32))
+    fm = bs.Flatmap(s, expand, out=[np.int32], fanout=2)
+    assert fm.mode == "jax"
+    rows = sorted(r[0] for r in slicetest.scan_all(fm, session=sess))
+    expected = sorted(
+        list(range(10)) + [x + 100 for x in range(0, 10, 2)]
+    )
+    assert rows == expected
+
+
+def test_flatmap_fixed_fanout_feeds_reduce(sess):
+    import jax.numpy as jnp
+
+    def dup(k, v):
+        return (jnp.array([True, True]),
+                jnp.stack([k, k]), jnp.stack([v, v]))
+
+    s = bs.Const(3, np.arange(30, dtype=np.int32) % 5,
+                 np.ones(30, dtype=np.int32))
+    fm = bs.Flatmap(s, dup, out=[np.int32, np.int32], fanout=2)
+    r = bs.Reduce(fm, lambda a, b: a + b)
+    assert dict(slicetest.scan_all(r, session=sess)) == {
+        i: 12 for i in range(5)
+    }
