@@ -68,6 +68,7 @@ class Compiler:
         # executors).
         self.serial = next(_compiler_serial)
         self._memo: Dict[Tuple[int, int], List[Task]] = {}
+        self._op_names: Dict[str, int] = {}
 
     def compile(self, slice_: Slice,
                 part: Optional[Partitioner] = None) -> List[Task]:
@@ -145,6 +146,15 @@ class Compiler:
             op_name = f"{op_name}@{os.path.basename(loc.file)}:{loc.line}"
         if loc.index:
             op_name = f"{op_name}#{loc.index}"
+        # Distinct partition configs of the same slice produce distinct
+        # task sets; their names must differ too, or their store entries
+        # would clobber each other (same (TaskName, partition) keys) and
+        # consumers could read the other config's output. Suffix every
+        # config after the first.
+        seen = self._op_names.setdefault(op_name, 0)
+        self._op_names[op_name] = seen + 1
+        if seen:
+            op_name = f"{op_name}~{seen}"
 
         slice_names = [str(s.name) for s in chain]
         tasks: List[Task] = []
@@ -193,10 +203,17 @@ class Compiler:
         if part.num_partition == 1 and part.combiner is None:
             return prior
         adapters = []
+        base_op = f"{prior[0].name.op}_shuffle" if prior else "_shuffle"
+        # Same dedup as the normal path: distinct partition configs of
+        # one Result must not share adapter TaskNames (store keys).
+        seen = self._op_names.setdefault(base_op, 0)
+        self._op_names[base_op] = seen + 1
+        if seen:
+            base_op = f"{base_op}~{seen}"
         for shard, ptask in enumerate(prior):
             name = TaskName(
                 self.inv_index,
-                f"{ptask.name.op}_shuffle",
+                base_op,
                 shard,
                 len(prior),
             )

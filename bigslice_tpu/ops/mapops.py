@@ -197,7 +197,13 @@ class MapBatches(_Pipelined):
 def _conform(cols, schema):
     """Coerce device columns to the declared dtypes so the frame schema
     never lies about its columns (the invariant Map's jax path enforces
-    by casting)."""
+    by casting). Raises on column-count mismatch rather than silently
+    truncating."""
+    if len(cols) != len(schema):
+        raise typecheck.errorf(
+            "batch function returned %d columns but out= declares %d",
+            len(cols), len(schema),
+        )
     out = []
     for c, ct in zip(cols, schema):
         if ct.is_device:

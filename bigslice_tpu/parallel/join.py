@@ -100,20 +100,18 @@ class MeshJoinAggregate:
         ))
 
     def __call__(self, a_cols, a_counts, b_cols, b_counts):
-        ka, va, na, overflow_a = self._side(self.a_reduce, a_cols,
-                                            a_counts)
-        kb, vb, nb, overflow_b = self._side(self.b_reduce, b_cols,
-                                            b_counts)
+        # Dispatch both reduces before any host sync so the two
+        # independent SPMD programs overlap; overflows convert to host
+        # only after the align is dispatched.
+        ka, va, na, ov_a = self.a_reduce([a_cols[0]], [a_cols[1]],
+                                         a_counts)
+        kb, vb, nb, ov_b = self.b_reduce([b_cols[0]], [b_cols[1]],
+                                         b_counts)
         out_counts, keys, avals, bvals = self._align(
             na, nb, ka[0], va[0], kb[0], vb[0]
         )
         return (keys, avals, bvals, out_counts,
-                overflow_a + overflow_b)
-
-    @staticmethod
-    def _side(reducer, cols, counts):
-        k, v, n, ov = reducer([cols[0]], [cols[1]], counts)
-        return k, v, n, np.asarray(ov)
+                np.asarray(ov_a) + np.asarray(ov_b))
 
 
 def join_count_oracle(a_keys, b_keys) -> dict:

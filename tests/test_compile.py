@@ -141,3 +141,40 @@ def test_golden_reshuffle_chain():
     r = bs.Reshuffle(s)
     m = bs.Map(r, lambda x: x + 1)
     check_golden("reshuffle-chain", graph(m))
+
+
+def test_distinct_configs_get_distinct_task_names():
+    """Regression: same-slice producer sets for different partition
+    configs must carry different TaskNames, or their store entries
+    clobber each other (last-writer-wins reads)."""
+    s = bs.Const(2, np.array([1, 1, 2, 2], np.int32),
+                 np.ones(4, dtype=np.int32))
+    r = bs.Reduce(s, lambda a, b: a + b)
+    p = bs.Reshuffle(s)
+    cg = bs.Cogroup(
+        bs.Map(r, lambda k, v: (k, v)),
+        bs.Map(p, lambda k, v: (k, v)),
+    )
+    tasks = compile_mod.Compiler(1).compile(cg)
+    names = [str(t.name) for t in iter_tasks(tasks)]
+    assert len(names) == len(set(names)), names
+
+
+def test_result_reuse_adapters_distinct_names():
+    """Regression: shuffle-adapter tasks for distinct partition configs
+    of one Result must carry distinct TaskNames."""
+    from bigslice_tpu.exec.session import Session
+
+    sess = Session()
+    res = sess.run(bs.Const(2, np.array([1, 1, 2, 2] * 8, np.int32),
+                            np.ones(32, dtype=np.int32)))
+    r = bs.Reduce(res, lambda a, b: a + b)
+    p = bs.Reshuffle(res)
+    cg = bs.Cogroup(
+        bs.Map(r, lambda k, v: (k, v)),
+        bs.Map(p, lambda k, v: (k, v)),
+    )
+    rows = sorted(sess.run(cg).rows())
+    assert [(k, len(a), len(b)) for k, a, b in rows] == [
+        (1, 1, 16), (2, 1, 16)
+    ]
