@@ -395,9 +395,15 @@ class MeshExecutor:
         in_ncols = self._input_ncols(task)
 
         def stepped(counts, *cols_and_extras):
+            # Mask-chained stages: validity rides as a bool mask between
+            # stages (no per-stage compaction sorts — filters and
+            # combiners just update the mask); one final compaction sort
+            # establishes the front-packed output contract.
             n = counts[0]
             cols = list(cols_and_extras[:in_ncols])
             extras = list(cols_and_extras[in_ncols:])
+            size = cols[0].shape[0]
+            mask = jnp.arange(size, dtype=np.int32) < n
             overflow = jnp.int32(0)
             for kind, _, s in stages:
                 if kind == "map":
@@ -412,22 +418,15 @@ class MeshExecutor:
                         out = (out,)
                     cols = [jnp.asarray(o) for o in out]
                 elif kind == "filter":
-                    size = cols[0].shape[0]
-                    mask = jax.vmap(s.pred)(*cols)
-                    keep = mask & (jnp.arange(size, dtype=np.int32) < n)
-                    drop = (~keep).astype(np.int32)
-                    packed = lax.sort((drop,) + tuple(cols), num_keys=1,
-                                      is_stable=True)
-                    cols = list(packed[1:])
-                    n = keep.sum().astype(np.int32)
+                    mask = mask & jax.vmap(s.pred)(*cols)
                 elif kind == "combine":
                     fc = s.frame_combiner
-                    core = segment.make_segmented_reduce(
+                    core = segment.make_segmented_reduce_masked(
                         fc.nkeys, fc.nvals,
                         segment.canonical_combine(fc.fn, fc.nvals),
                     )
-                    n, keys, vals = core(
-                        n, tuple(cols[: fc.nkeys]),
+                    mask, keys, vals = core(
+                        mask, tuple(cols[: fc.nkeys]),
                         tuple(cols[fc.nkeys :]),
                     )
                     cols = list(keys) + list(vals)
@@ -436,22 +435,28 @@ class MeshExecutor:
                     fc = part.combiner
                     nkeys = s.schema.prefix
                     if fc is not None:
-                        core = segment.make_segmented_reduce(
+                        core = segment.make_segmented_reduce_masked(
                             fc.nkeys, fc.nvals,
                             segment.canonical_combine(fc.fn, fc.nvals),
                         )
-                        n, keys, vals = core(
-                            n, tuple(cols[: fc.nkeys]),
+                        mask, keys, vals = core(
+                            mask, tuple(cols[: fc.nkeys]),
                             tuple(cols[fc.nkeys :]),
                         )
                         cols = list(keys) + list(vals)
                     body = shuffle_mod.make_shuffle_fn(
                         nmesh, nkeys, cols[0].shape[0], axis, slack=slack
                     )
-                    n, ov, cols = body(n, *cols)
+                    mask, ov, cols = body.masked(mask, *cols)
                     cols = list(cols)
                     overflow = overflow + ov
-            return (jnp.asarray(n).reshape(1), overflow, tuple(cols))
+            # Final compaction to the front-packed (cols, count) contract.
+            inv = (~mask).astype(np.int32)
+            packed = lax.sort((inv,) + tuple(cols), num_keys=1,
+                              is_stable=True)
+            cols = list(packed[1:])
+            out_n = mask.sum().astype(np.int32)
+            return (out_n.reshape(1), overflow, tuple(cols))
 
         ncols_out = len(task.schema)
         col_spec = P(axis)

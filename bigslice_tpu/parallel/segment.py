@@ -41,32 +41,30 @@ def canonical_combine(fn: Callable, nvals: int) -> Callable:
     return cfn
 
 
-def make_segmented_reduce(nkeys: int, nvals: int, cfn):
-    """The shared traceable core: sort rows by (validity, keys), find
-    segment boundaries, apply ``cfn`` per segment via a segmented
-    associative scan, and compact survivors to the front.
+def make_segmented_reduce_masked(nkeys: int, nvals: int, cfn,
+                                 compact: bool = False):
+    """Mask-based variant of the segmented reduce core.
 
-    Returns ``core(n, key_cols, val_cols) -> (count, keys, vals)`` where
-    inputs are equal-length device columns, ``n`` is the valid-row count,
-    and outputs have one front-compacted row per distinct valid key
-    (sorted by key). Used by both the single-device combiner
-    (DeviceReduceByKey) and the mesh reduce (shuffle.MeshReduceByKey).
+    ``core(valid_mask, key_cols, val_cols)`` reduces the rows selected by
+    ``valid_mask`` (bool[size]). With ``compact=False`` it returns
+    ``(keep_mask, keys, vals)`` — reduced rows *in sorted position* with
+    a survivor mask, skipping the compaction sort entirely (chained
+    stages that accept masks, e.g. the shuffle, don't need front-packed
+    rows). With ``compact=True`` it returns ``(count, keys, vals)``
+    front-compacted (the output contract).
     """
     import jax.numpy as jnp
     from jax import lax
 
-    def core(n, key_cols, val_cols):
+    def core(valid_mask, key_cols, val_cols):
         size = key_cols[0].shape[0]
-        invalid = (jnp.arange(size, dtype=np.int32) >= n).astype(np.int32)
+        invalid = (~valid_mask).astype(np.int32)
         ops = (invalid,) + tuple(key_cols) + tuple(val_cols)
         s = lax.sort(ops, num_keys=1 + nkeys, is_stable=True)
         s_invalid = s[0]
         s_keys = s[1 : 1 + nkeys]
         s_vals = s[1 + nkeys :]
 
-        # Segment starts: row 0, any key change, validity change; padded
-        # rows each form their own segment so they can't contaminate
-        # real reductions.
         diff = jnp.zeros(size, dtype=bool).at[0].set(True)
         for k in (s_invalid,) + tuple(s_keys):
             diff = diff.at[1:].set(diff[1:] | (k[1:] != k[:-1]))
@@ -83,12 +81,32 @@ def make_segmented_reduce(nkeys: int, nvals: int, cfn):
         _, red = lax.associative_scan(scan_op, (diff, tuple(s_vals)))
         is_last = jnp.ones(size, dtype=bool).at[:-1].set(diff[1:])
         keep = is_last & (s_invalid == 0)
+        if not compact:
+            return keep, s_keys, tuple(red)
         drop = (~keep).astype(np.int32)
         packed = lax.sort((drop,) + tuple(s_keys) + tuple(red),
                           num_keys=1, is_stable=True)
         return (keep.sum().astype(np.int32),
                 tuple(packed[1 : 1 + nkeys]),
                 tuple(packed[1 + nkeys :]))
+
+    return core
+
+
+def make_segmented_reduce(nkeys: int, nvals: int, cfn):
+    """Count-based wrapper over the masked core: ``core(n, key_cols,
+    val_cols) -> (count, keys, vals)`` with survivors front-compacted
+    (sorted by key). One kernel body serves both this and the mask-
+    chained mesh stages.
+    """
+    import jax.numpy as jnp
+
+    masked = make_segmented_reduce_masked(nkeys, nvals, cfn, compact=True)
+
+    def core(n, key_cols, val_cols):
+        size = key_cols[0].shape[0]
+        mask = jnp.arange(size, dtype=np.int32) < n
+        return masked(mask, key_cols, val_cols)
 
     return core
 

@@ -61,10 +61,13 @@ def make_shuffle_fn(nshards: int, nkeys: int, capacity: int,
 
     send_cap = send_capacity(capacity, nshards, slack)
 
-    def body(n, *cols):
+    def body_masked(valid, *cols):
+        """Mask-based core: rows where ``valid`` route; returns
+        (recv_valid_mask, overflow, out_cols) with received rows left in
+        bucket position (no compaction sort) — consumers that accept
+        masks (segmented reduce) chain without the extra sort."""
         size = cols[0].shape[0]
         keys = cols[:nkeys]
-        valid = jnp.arange(size, dtype=np.int32) < n
         if partition_fn is not None:
             part = jnp.asarray(partition_fn(*keys)).astype(np.int32)
             # Out-of-range ids route to the drop lane and are counted in
@@ -129,15 +132,22 @@ def make_shuffle_fn(nshards: int, nkeys: int, capacity: int,
         row_in_bucket = jnp.arange(send_cap, dtype=np.int32)
         valid_mask = (row_in_bucket[None, :]
                       < recv_counts[:, None]).reshape(-1)
-        # Compact valid rows to the front (sort by ~valid, stable).
+        total_overflow = lax.psum(overflow, axis)
+        return valid_mask, total_overflow, out_cols
+
+    def body(n, *cols):
+        size = cols[0].shape[0]
+        valid = jnp.arange(size, dtype=np.int32) < n
+        valid_mask, total_overflow, out_cols = body_masked(valid, *cols)
+        # Compact valid rows to the front (count-based output contract).
         inv = (~valid_mask).astype(np.int32)
         packed = lax.sort((inv,) + tuple(out_cols), num_keys=1,
                           is_stable=True)
         out_cols = list(packed[1:])
-        out_count = recv_counts.sum().astype(np.int32)
-        total_overflow = lax.psum(overflow, axis)
+        out_count = valid_mask.sum().astype(np.int32)
         return out_count, total_overflow, out_cols
 
+    body.masked = body_masked
     return body
 
 
@@ -214,22 +224,34 @@ class MeshReduceByKey:
         cfn = segment.canonical_combine(combine_fn, nvals)
         shuffle_body = make_shuffle_fn(nshards, nkeys, capacity,
                                        axis, seed, slack=slack)
-        # Shared segmented-reduce core (same kernel as the single-device
-        # combiner, parallel/segment.py).
-        combine_local = segment.make_segmented_reduce(nkeys, nvals, cfn)
+        # Mask-chained stages (parallel/segment.py): intermediate stages
+        # pass validity masks instead of front-compacting, skipping two
+        # full-buffer sorts per step versus the count-based chain.
+        combine_masked = segment.make_segmented_reduce_masked(
+            nkeys, nvals, cfn, compact=False
+        )
+        combine_final = segment.make_segmented_reduce_masked(
+            nkeys, nvals, cfn, compact=True
+        )
 
         def stepped(counts, *cols):
+            import jax.numpy as jnp
+
             n = counts[0]
+            size = cols[0].shape[0]
             key_cols = cols[:nkeys]
             val_cols = cols[nkeys:]
-            # 1. map-side combine
-            n1, k1, v1 = combine_local(n, key_cols, val_cols)
-            # 2. shuffle by key hash
-            n2, overflow, out_cols = shuffle_body(n1, *(tuple(k1) + tuple(v1)))
+            mask0 = jnp.arange(size, dtype=np.int32) < n
+            # 1. map-side combine (uncompacted; survivor mask)
+            keep1, k1, v1 = combine_masked(mask0, key_cols, val_cols)
+            # 2. shuffle by key hash (mask in, mask out)
+            recv_mask, overflow, out_cols = shuffle_body.masked(
+                keep1, *(tuple(k1) + tuple(v1))
+            )
             k2 = tuple(out_cols[:nkeys])
             v2 = tuple(out_cols[nkeys:])
-            # 3. reduce-side combine
-            n3, k3, v3 = combine_local(n2, k2, v2)
+            # 3. reduce-side combine (front-compacted output contract)
+            n3, k3, v3 = combine_final(recv_mask, k2, v2)
             return (n3.reshape(1), overflow,
                     tuple(k3) + tuple(v3))
 
