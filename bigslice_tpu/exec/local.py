@@ -26,6 +26,12 @@ from bigslice_tpu.exec.task import Task, TaskState
 from bigslice_tpu.utils import metrics as metrics_mod
 
 
+# Rows per partition buffer before an incremental pre-combine bounds the
+# working set (the reference's combiner spill threshold role,
+# exec/combiner.go:227-305 — on-device re-combining replaces disk spill).
+COMBINE_FLUSH_ROWS = 1 << 20
+
+
 class DepLost(Exception):
     """A dependency's stored output is gone; carries the producer task(s)
     to mark LOST for re-evaluation. Machine-combined deps lose the whole
@@ -192,6 +198,8 @@ class LocalExecutor:
             self.store.put(task.name, 0, [f for f in reader if len(f)])
             return
         parts: List[List[Frame]] = [[] for _ in range(nparts)]
+        pending_rows = [0] * nparts
+        flush_at = [COMBINE_FLUSH_ROWS] * nparts
         for frame in reader:
             if not len(frame):
                 continue
@@ -199,6 +207,19 @@ class LocalExecutor:
             for p, sub in enumerate(partition_frame(frame, ids, nparts)):
                 if len(sub):
                     parts[p].append(sub)
+                    pending_rows[p] += len(sub)
+                    if (task.combiner is not None
+                            and pending_rows[p] >= flush_at[p]):
+                        # Incremental pre-combine: associativity lets us
+                        # collapse the buffer early, bounding memory for
+                        # high-cardinality streams. The doubling trigger
+                        # keeps it amortized O(rows log rows) even when
+                        # distinct keys exceed the threshold.
+                        combined = task.combiner.combine_frames(parts[p])
+                        parts[p] = [combined] if len(combined) else []
+                        pending_rows[p] = len(combined)
+                        flush_at[p] = max(COMBINE_FLUSH_ROWS,
+                                          2 * len(combined))
         comb = task.combiner
         ck = task.partitioner.combine_key
         if comb is not None and ck:

@@ -394,6 +394,10 @@ class MeshExecutor:
         )
         in_ncols = self._input_ncols(task)
 
+        # Map-only chains never touch the mask; their final compaction
+        # would be an identity permutation — skip it at trace time.
+        mask_dirty = any(k != "map" for k, _, _ in stages)
+
         def stepped(counts, *cols_and_extras):
             # Mask-chained stages: validity rides as a bool mask between
             # stages (no per-stage compaction sorts — filters and
@@ -450,12 +454,10 @@ class MeshExecutor:
                     mask, ov, cols = body.masked(mask, *cols)
                     cols = list(cols)
                     overflow = overflow + ov
+            if not mask_dirty:
+                return (jnp.asarray(n).reshape(1), overflow, tuple(cols))
             # Final compaction to the front-packed (cols, count) contract.
-            inv = (~mask).astype(np.int32)
-            packed = lax.sort((inv,) + tuple(cols), num_keys=1,
-                              is_stable=True)
-            cols = list(packed[1:])
-            out_n = mask.sum().astype(np.int32)
+            out_n, cols = segment.compact_by_mask(mask, cols)
             return (out_n.reshape(1), overflow, tuple(cols))
 
         ncols_out = len(task.schema)

@@ -41,6 +41,18 @@ def canonical_combine(fn: Callable, nvals: int) -> Callable:
     return cfn
 
 
+def compact_by_mask(mask, cols):
+    """Front-compact rows selected by ``mask`` (stable; preserves the
+    relative order of survivors). Returns (count, cols). The one shared
+    implementation of the capacity+validity → front-packed conversion."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    inv = (~mask).astype(np.int32)
+    packed = lax.sort((inv,) + tuple(cols), num_keys=1, is_stable=True)
+    return mask.sum().astype(np.int32), tuple(packed[1:])
+
+
 def make_segmented_reduce_masked(nkeys: int, nvals: int, cfn,
                                  compact: bool = False):
     """Mask-based variant of the segmented reduce core.
@@ -83,12 +95,8 @@ def make_segmented_reduce_masked(nkeys: int, nvals: int, cfn,
         keep = is_last & (s_invalid == 0)
         if not compact:
             return keep, s_keys, tuple(red)
-        drop = (~keep).astype(np.int32)
-        packed = lax.sort((drop,) + tuple(s_keys) + tuple(red),
-                          num_keys=1, is_stable=True)
-        return (keep.sum().astype(np.int32),
-                tuple(packed[1 : 1 + nkeys]),
-                tuple(packed[1 + nkeys :]))
+        count, packed = compact_by_mask(keep, tuple(s_keys) + tuple(red))
+        return count, packed[:nkeys], packed[nkeys:]
 
     return core
 

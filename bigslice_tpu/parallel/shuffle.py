@@ -136,16 +136,14 @@ def make_shuffle_fn(nshards: int, nkeys: int, capacity: int,
         return valid_mask, total_overflow, out_cols
 
     def body(n, *cols):
+        from bigslice_tpu.parallel.segment import compact_by_mask
+
         size = cols[0].shape[0]
         valid = jnp.arange(size, dtype=np.int32) < n
         valid_mask, total_overflow, out_cols = body_masked(valid, *cols)
         # Compact valid rows to the front (count-based output contract).
-        inv = (~valid_mask).astype(np.int32)
-        packed = lax.sort((inv,) + tuple(out_cols), num_keys=1,
-                          is_stable=True)
-        out_cols = list(packed[1:])
-        out_count = valid_mask.sum().astype(np.int32)
-        return out_count, total_overflow, out_cols
+        out_count, out_cols = compact_by_mask(valid_mask, out_cols)
+        return out_count, total_overflow, list(out_cols)
 
     body.masked = body_masked
     return body

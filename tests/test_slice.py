@@ -438,3 +438,16 @@ def test_filestore_backed_session(tmp_path):
     assert files
     # Re-read straight from disk through the store API.
     assert dict(res.rows()) == expect
+
+
+def test_incremental_combine_bounds_memory(monkeypatch):
+    """With a tiny flush threshold the combiner pre-collapses buffers
+    mid-stream; results are identical (associativity)."""
+    import bigslice_tpu.exec.local as local_mod
+
+    monkeypatch.setattr(local_mod, "COMBINE_FLUSH_ROWS", 64)
+    keys = np.arange(4000, dtype=np.int32) % 11
+    r = bs.Reduce(bs.Const(2, keys, np.ones(4000, dtype=np.int32)),
+                  lambda a, b: a + b)
+    got = dict(Session().run(r).rows())
+    assert got == {i: len([k for k in keys if k == i]) for i in range(11)}
