@@ -189,7 +189,7 @@ class MeshExecutor:
             if len(dep.tasks) not in (1, self.nmesh):
                 return False
         from bigslice_tpu.ops.const import Const
-        from bigslice_tpu.ops.mapops import Filter, Map, _PrefixedSlice
+        from bigslice_tpu.ops.mapops import Filter, Head, Map, _PrefixedSlice
         from bigslice_tpu.ops.reduce import Reduce
         from bigslice_tpu.ops.reshuffle import Reshard, Reshuffle
         from bigslice_tpu.ops.source import ReaderFunc
@@ -203,6 +203,8 @@ class MeshExecutor:
             if isinstance(s, (Map, Filter)):
                 if s.mode != "jax":
                     return False
+                continue
+            if isinstance(s, Head):
                 continue
             if isinstance(s, Reduce):
                 if not s.frame_combiner.device:
@@ -350,7 +352,7 @@ class MeshExecutor:
     def _stages_for(self, task: Task) -> List[tuple]:
         """Flatten the chain (innermost→outermost) + output partitioner
         into device stage descriptors (kind, struct_id, slice)."""
-        from bigslice_tpu.ops.mapops import Filter, Map
+        from bigslice_tpu.ops.mapops import Filter, Head, Map
         from bigslice_tpu.ops.reduce import Reduce
 
         stages: List[tuple] = []
@@ -359,6 +361,8 @@ class MeshExecutor:
                 stages.append(("map", (id(s.fn), len(s.args)), s))
             elif isinstance(s, Filter):
                 stages.append(("filter", id(s.pred), s))
+            elif isinstance(s, Head):
+                stages.append(("head", s.n, s))
             elif isinstance(s, Reduce):
                 fc = s.frame_combiner
                 stages.append(("combine", (id(fc.fn), fc.nkeys, fc.nvals),
@@ -423,6 +427,11 @@ class MeshExecutor:
                     cols = [jnp.asarray(o) for o in out]
                 elif kind == "filter":
                     mask = mask & jax.vmap(s.pred)(*cols)
+                elif kind == "head":
+                    # First n valid rows per shard: rank valid rows by
+                    # running count (Head, slice.go:966).
+                    rank = jnp.cumsum(mask.astype(np.int32))
+                    mask = mask & (rank <= s.n)
                 elif kind == "combine":
                     fc = s.frame_combiner
                     core = segment.make_segmented_reduce_masked(

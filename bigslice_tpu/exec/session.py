@@ -162,10 +162,14 @@ class Session:
         callable, or a ``Slice`` directly (test convenience, mirroring
         slicetest.Run).
         """
+        exclusive = False
         if isinstance(func, Func):
             inv = func.invocation(*args)
             slice_ = inv.invoke()
             inv_index = inv.index
+            # Exclusive Funcs mark every task of the invocation (not the
+            # user's slice objects, which may be shared across Funcs).
+            exclusive = func.exclusive
         elif isinstance(func, Slice):
             typecheck.check(not args, "run: args given with a literal slice")
             slice_ = func
@@ -184,7 +188,8 @@ class Session:
                 type(func).__name__,
             )
         tasks = compile_mod.Compiler(
-            inv_index, machine_combiners=self.machine_combiners
+            inv_index, machine_combiners=self.machine_combiners,
+            exclusive=exclusive,
         ).compile(slice_)
         if self.debug is not None:
             self.debug.register_roots(tasks)

@@ -167,3 +167,12 @@ def test_same_op_different_configs_not_merged(mesh):
     assert [(k, len(a), len(b)) for k, a, b in rows] == [
         (1, 1, 32), (2, 1, 32)
     ]
+
+
+def test_head_on_mesh(sess):
+    s = bs.Const(8, np.arange(800, dtype=np.int32))
+    h = bs.Head(bs.Filter(s, lambda x: x % 2 == 0), 5)
+    rows = sess.run(h).rows()
+    assert len(rows) == 40  # 5 per shard
+    assert all(v % 2 == 0 for (v,) in rows)
+    assert len(sess.executor._outputs) >= 1  # ran on the device path

@@ -451,3 +451,24 @@ def test_incremental_combine_bounds_memory(monkeypatch):
                   lambda a, b: a + b)
     got = dict(Session().run(r).rows())
     assert got == {i: len([k for k in keys if k == i]) for i in range(11)}
+
+
+def test_exclusive_func_takes_whole_budget():
+    from bigslice_tpu.exec.task import iter_tasks
+
+    shared = bs.Const(2, np.array([1, 2, 1, 2], np.int32),
+                      np.ones(4, dtype=np.int32))
+
+    @bs.func(exclusive=True)
+    def excl():
+        # Multi-stage: upstream (pre-shuffle) tasks must be exclusive too.
+        return bs.Reduce(shared, lambda a, b: a + b)
+
+    sess = Session()
+    res = sess.run(excl)
+    assert dict(res.rows()) == {1: 2, 2: 2}
+    assert all(t.exclusive for t in iter_tasks(res.tasks))
+    # The user's shared slice must NOT be contaminated.
+    assert not shared.exclusive
+    res2 = sess.run(bs.Map(shared, lambda k, v: (k, v)))
+    assert not any(t.exclusive for t in iter_tasks(res2.tasks))
