@@ -57,14 +57,8 @@ _compiler_serial = _itertools.count(1)
 
 
 class Compiler:
-    def __init__(self, inv_index: int, machine_combiners: bool = False,
-                 exclusive: bool = False):
+    def __init__(self, inv_index: int, machine_combiners: bool = False):
         self.inv_index = inv_index
-        # Exclusive invocations: every task of the graph takes the whole
-        # proc budget (the reference dedicates a cluster to exclusive
-        # Funcs, exec/bigmachine.go:314-319; process-wide exclusivity is
-        # the single-host analog).
-        self.exclusive = exclusive
         # MachineCombiners: share one combiner buffer per process across
         # all producer tasks of a shuffle (exec/session.go:166-176,
         # worker-side two-level combine exec/bigmachine.go:1084-1210).
@@ -185,7 +179,7 @@ class Compiler:
                 partitioner=part,
                 schema=slice_.schema,
                 procs=slice_.procs,
-                exclusive=slice_.exclusive or self.exclusive,
+                exclusive=slice_.exclusive,
                 slice_names=slice_names,
             )
             # Structural metadata for executors that vectorize whole op

@@ -23,6 +23,39 @@ from bigslice_tpu.exec.task import Task, TaskState
 from bigslice_tpu.utils import metrics as metrics_mod
 
 
+class _InvocationGate:
+    """Reader-writer isolation for exclusive invocations: normal runs
+    share the session (readers); an exclusive Func's run takes the whole
+    session (writer) — the single-host analog of the reference's
+    dedicated cluster per exclusive Func (exec/bigmachine.go:314-319),
+    preserving intra-invocation shard parallelism (unlike per-task
+    Pragma.Exclusive, which takes the whole proc budget per task)."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._readers = 0
+        self._writer = False
+
+    def acquire(self, exclusive: bool) -> None:
+        with self._cond:
+            if exclusive:
+                self._cond.wait_for(
+                    lambda: not self._writer and self._readers == 0
+                )
+                self._writer = True
+            else:
+                self._cond.wait_for(lambda: not self._writer)
+                self._readers += 1
+
+    def release(self, exclusive: bool) -> None:
+        with self._cond:
+            if exclusive:
+                self._writer = False
+            else:
+                self._readers -= 1
+            self._cond.notify_all()
+
+
 class Result(Slice):
     """A computed slice: the output of a session run (exec/session.go:391).
 
@@ -140,6 +173,7 @@ class Session:
 
             self.debug = DebugServer(self, debug_port)
         self._inv_index = itertools.count(1)
+        self._gate = _InvocationGate()
         executor.start(self)
         self._event("bigslice:sessionStart", executor=executor.name)
 
@@ -167,8 +201,6 @@ class Session:
             inv = func.invocation(*args)
             slice_ = inv.invoke()
             inv_index = inv.index
-            # Exclusive Funcs mark every task of the invocation (not the
-            # user's slice objects, which may be shared across Funcs).
             exclusive = func.exclusive
         elif isinstance(func, Slice):
             typecheck.check(not args, "run: args given with a literal slice")
@@ -188,12 +220,17 @@ class Session:
                 type(func).__name__,
             )
         tasks = compile_mod.Compiler(
-            inv_index, machine_combiners=self.machine_combiners,
-            exclusive=exclusive,
+            inv_index, machine_combiners=self.machine_combiners
         ).compile(slice_)
         if self.debug is not None:
             self.debug.register_roots(tasks)
-        evaluate(self.executor, tasks, monitor=self.monitor)
+        # Exclusive invocations evaluate in isolation from concurrent
+        # runs of this session; their own shards stay parallel.
+        self._gate.acquire(exclusive)
+        try:
+            evaluate(self.executor, tasks, monitor=self.monitor)
+        finally:
+            self._gate.release(exclusive)
         return Result(self, slice_, tasks)
 
     # Go-flavored alias (Session.Must): raise on error is Python's default.

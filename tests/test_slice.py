@@ -453,22 +453,55 @@ def test_incremental_combine_bounds_memory(monkeypatch):
     assert got == {i: len([k for k in keys if k == i]) for i in range(11)}
 
 
-def test_exclusive_func_takes_whole_budget():
-    from bigslice_tpu.exec.task import iter_tasks
+def test_exclusive_func_isolates_invocation():
+    """Exclusive Funcs evaluate in isolation from concurrent session
+    runs (the reference's dedicated-cluster semantics) while their own
+    shards stay parallel — no per-task exclusivity, no slice mutation."""
+    import threading
+    import time
 
     shared = bs.Const(2, np.array([1, 2, 1, 2], np.int32),
                       np.ones(4, dtype=np.int32))
 
+    intervals = {}
+    ilock = threading.Lock()
+
+    def track(tag):
+        def fn(k, v):
+            t0 = time.perf_counter()
+            time.sleep(0.05)
+            with ilock:
+                intervals.setdefault(tag, []).append(
+                    (t0, time.perf_counter())
+                )
+            return (int(k), int(v))
+        return fn
+
     @bs.func(exclusive=True)
     def excl():
-        # Multi-stage: upstream (pre-shuffle) tasks must be exclusive too.
-        return bs.Reduce(shared, lambda a, b: a + b)
+        return bs.Map(shared, track("excl"), out=[np.int32, np.int32],
+                      mode="host")
 
     sess = Session()
-    res = sess.run(excl)
-    assert dict(res.rows()) == {1: 2, 2: 2}
-    assert all(t.exclusive for t in iter_tasks(res.tasks))
-    # The user's shared slice must NOT be contaminated.
+    results = {}
+
+    def normal_run():
+        results["normal"] = sess.run(
+            bs.Map(shared, track("norm"), out=[np.int32, np.int32],
+                   mode="host")
+        ).rows()
+
+    threads = [threading.Thread(target=normal_run) for _ in range(2)]
+    for t in threads:
+        t.start()
+    results["excl"] = sess.run(excl).rows()
+    for t in threads:
+        t.join(timeout=30)
+    assert sorted(results["excl"]) == [(1, 1), (1, 1), (2, 1), (2, 1)]
+    assert sorted(results["normal"]) == sorted(results["excl"])
+    # No normal-task interval overlaps any exclusive-task interval.
+    for es, ee in intervals["excl"]:
+        for ns, ne in intervals.get("norm", []):
+            assert ee <= ns or ne <= es, "exclusive run overlapped normal"
+    # The user's shared slice was never contaminated.
     assert not shared.exclusive
-    res2 = sess.run(bs.Map(shared, lambda k, v: (k, v)))
-    assert not any(t.exclusive for t in iter_tasks(res2.tasks))
