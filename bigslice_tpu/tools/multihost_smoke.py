@@ -1,0 +1,155 @@
+"""Multi-host smoke test: the SPMD session across real process boundaries.
+
+Spawns N Python processes on localhost, each a jax.distributed
+participant with its own CPU device(s); together they form one global
+mesh. The smoke run exercises, across actual process boundaries (the
+DCN shape of a TPU pod):
+
+- distributed bootstrap + Func-registry digest verification,
+- a data-parallel psum step (mesh k-means),
+- the full mesh reduce (hash + all_to_all + segmented combines).
+
+Usage (parent):  python -m bigslice_tpu.tools.multihost_smoke [N]
+The parent acts as process 0; children run the same module with
+``--worker``.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def worker(num_processes: int, process_id: int, port: int,
+           hard_exit: bool = True) -> int:
+    from bigslice_tpu.utils.hermetic import force_hermetic_cpu
+
+    force_hermetic_cpu()
+    import jax
+    import numpy as np
+    from jax.experimental import multihost_utils
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from bigslice_tpu.utils import distributed
+
+    distributed.initialize(
+        coordinator=f"127.0.0.1:{port}",
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    assert jax.process_count() == num_processes
+    mesh = distributed.global_mesh()
+    n = int(mesh.devices.size)
+    n_local = len([d for d in mesh.devices.flat
+                   if d.process_index == process_id])
+
+    def make_global(local_rows: "np.ndarray", global_shape):
+        return jax.make_array_from_process_local_data(
+            NamedSharding(mesh, P("shards")), local_rows, global_shape
+        )
+
+    # 1. Data-parallel psum step (mesh k-means) across processes.
+    from bigslice_tpu.models.kmeans import mesh_kmeans_step
+
+    rng = np.random.RandomState(0)
+    pts = rng.rand(n * 16, 4).astype(np.float32)
+    cents = pts[:2].copy()
+    local_pts = pts.reshape(num_processes, -1, 4)[process_id]
+    step = mesh_kmeans_step(mesh, k=2, d=4)
+    out = np.asarray(step(make_global(local_pts, pts.shape), cents))
+    assert out.shape == (2, 4) and np.isfinite(out).all()
+
+    # 2. Full mesh reduce (hash + all_to_all + segmented combines)
+    # across processes: every row carries value 1, keys in [0, 7).
+    from bigslice_tpu.parallel import shuffle as shuffle_mod
+
+    per, cap = 32, 64
+    local_keys = np.concatenate([
+        np.concatenate([rng.randint(0, 7, per).astype(np.int32),
+                        np.zeros(cap - per, np.int32)])
+        for _ in range(n_local)
+    ])
+    local_vals = np.concatenate([
+        np.concatenate([np.ones(per, np.int32),
+                        np.zeros(cap - per, np.int32)])
+        for _ in range(n_local)
+    ])
+    kcols = make_global(local_keys, (n * cap,))
+    vcols = make_global(local_vals, (n * cap,))
+    counts = make_global(np.full(n_local, per, np.int32), (n,))
+    red = shuffle_mod.MeshReduceByKey(mesh, 1, 1, cap,
+                                      lambda a, b: a + b)
+    k_out, v_out, out_counts, overflow = red([kcols], [vcols], counts)
+    assert int(np.asarray(overflow)) == 0
+
+    # Global row count must be preserved. Only each shard's valid prefix
+    # counts — the compacted tail holds non-survivor remnants.
+    counts_by_dev = {
+        s.device: int(s.data[0]) for s in out_counts.addressable_shards
+    }
+    local_sum = sum(
+        int(np.asarray(s.data)[: counts_by_dev[s.device]].sum())
+        for s in v_out[0].addressable_shards
+    )
+    sums = np.asarray(multihost_utils.process_allgather(
+        np.asarray([local_sum], np.int64)
+    ))
+    assert int(sums.sum()) == n * per, (int(sums.sum()), n * per)
+
+    if process_id == 0:
+        print(f"MULTIHOST_SMOKE_OK processes={num_processes} devices={n}",
+              flush=True)
+    try:
+        jax.distributed.shutdown()
+    except Exception:
+        pass
+    sys.stdout.flush()
+    if hard_exit:
+        # Children hard-exit: distributed service threads otherwise hang
+        # interpreter shutdown. The parent returns so it can reap them.
+        os._exit(0)
+    return 0
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    if argv and argv[0] == "--worker":
+        return worker(int(argv[1]), int(argv[2]), int(argv[3]))
+    nproc = int(argv[0]) if argv else 2
+    port = _free_port()  # fresh ephemeral port per run: no collisions
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "bigslice_tpu.tools.multihost_smoke",
+             "--worker", str(nproc), str(i), str(port)],
+            env=env,
+        )
+        for i in range(1, nproc)
+    ]
+    rc = 1  # failure until the parent worker completes
+    try:
+        rc = worker(nproc, 0, port, hard_exit=False)
+    finally:
+        for p in procs:
+            try:
+                rc |= p.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                rc |= 1
+    # All children reaped; now hard-exit past any lingering service
+    # threads in this (parent) process too.
+    sys.stdout.flush()
+    os._exit(rc)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

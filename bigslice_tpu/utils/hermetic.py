@@ -1,0 +1,24 @@
+"""Force a hermetic CPU jax backend.
+
+The TPU-tunnel plugin (axon) registers a backend factory at interpreter
+start via sitecustomize and backend init touches it even when
+JAX_PLATFORMS=cpu — a wedged tunnel then hangs any process. Tests and
+CPU-only tools deregister it outright through this one shared helper
+(private-API workaround lives in exactly one place).
+"""
+
+from __future__ import annotations
+
+
+def force_hermetic_cpu() -> None:
+    import os
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        import jax
+        from jax._src import xla_bridge as _xb
+
+        _xb._backend_factories.pop("axon", None)
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass

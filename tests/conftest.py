@@ -19,16 +19,8 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
-# The TPU-tunnel plugin (axon) registers a backend factory at interpreter
-# start via sitecustomize (importing jax in the process, so the env vars
-# above are too late for jax.config) and pins jax_platforms to the tunnel
-# — a wedged tunnel then hangs every test. Deregister it and repin the
-# config; tests never touch real TPU hardware.
-try:
-    import jax
-    from jax._src import xla_bridge as _xb
+# The TPU-tunnel plugin would otherwise hook backend init (and a wedged
+# tunnel hangs every test); tests never touch real TPU hardware.
+from bigslice_tpu.utils.hermetic import force_hermetic_cpu
 
-    _xb._backend_factories.pop("axon", None)
-    jax.config.update("jax_platforms", "cpu")
-except Exception:
-    pass
+force_hermetic_cpu()

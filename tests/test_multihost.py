@@ -1,0 +1,23 @@
+"""Multi-host (multi-process jax.distributed) smoke: the DCN-shaped
+validation of the SPMD model — separate OS processes form one mesh and
+run psum + all_to_all collectives across real process boundaries
+(SURVEY.md §5.8's control/data-plane replacement, tested hermetically
+like the reference's bigmachine/testsystem)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+
+def test_two_process_distributed_smoke():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, "-m", "bigslice_tpu.tools.multihost_smoke", "2"],
+        capture_output=True, text=True, timeout=180, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "MULTIHOST_SMOKE_OK processes=2" in out.stdout
