@@ -154,6 +154,12 @@ class MeshExecutor:
                 target=self._run_group, args=(key,), daemon=True
             ).start()
 
+    def device_group_count(self) -> int:
+        """How many op groups have run on the device path (diagnostics;
+        groups may legitimately fall back under scheduling pressure)."""
+        with self._lock:
+            return len(self._outputs)
+
     def reader(self, task: Task, partition: int) -> sliceio.Reader:
         return self.store.read(task.name, partition)
 

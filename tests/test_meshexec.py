@@ -34,7 +34,7 @@ def test_const_map_on_mesh(sess):
     res = sess.run(m)
     assert rows_sorted(res) == [(2 * i,) for i in range(64)]
     # The group actually ran on the device path.
-    assert len(sess.executor._outputs) >= 1
+    assert sess.executor.device_group_count() >= 1
 
 
 def test_reduce_on_mesh(sess):
@@ -48,7 +48,7 @@ def test_reduce_on_mesh(sess):
         oracle[k] = oracle.get(k, 0) + v
     assert dict(res.rows()) == oracle
     # Both producer and reducer groups device-resident.
-    assert len(sess.executor._outputs) >= 2
+    assert sess.executor.device_group_count() >= 2
 
 
 def test_filter_map_chain_on_mesh(sess):
@@ -112,7 +112,7 @@ def test_shard_count_mismatch_falls_back(mesh):
     res = sess.run(r)
     assert dict(res.rows()) == {i: 50 // 7 + (1 if i < 50 % 7 else 0)
                                 for i in range(7)}
-    assert not sess.executor._outputs
+    assert sess.executor.device_group_count() == 0
 
 
 def test_result_reuse_across_runs(sess):
@@ -175,4 +175,4 @@ def test_head_on_mesh(sess):
     rows = sess.run(h).rows()
     assert len(rows) == 40  # 5 per shard
     assert all(v % 2 == 0 for (v,) in rows)
-    assert len(sess.executor._outputs) >= 1  # ran on the device path
+    assert sess.executor.device_group_count() >= 1  # ran on the device path
