@@ -15,32 +15,13 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
 
 import json
-import subprocess
 import sys
 import time
 
 import numpy as np
 
 
-def _ensure_usable_backend(timeout: float = 90.0) -> str:
-    """Probe device init in a subprocess; a wedged TPU tunnel hangs
-    inside native code (unkillable in-process), so probe out-of-process
-    and fall back to CPU rather than hanging the benchmark."""
-    try:
-        subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=timeout, capture_output=True, check=True,
-        )
-        return "default"
-    except (subprocess.TimeoutExpired, subprocess.CalledProcessError):
-        print("bench: device backend unavailable (tunnel hang?); "
-              "falling back to CPU", file=sys.stderr)
-        import jax
-        from jax._src import xla_bridge as _xb
 
-        _xb._backend_factories.pop("axon", None)
-        jax.config.update("jax_platforms", "cpu")
-        return "cpu-fallback"
 
 
 def cpu_baseline(keys: np.ndarray, vals: np.ndarray) -> float:
@@ -156,7 +137,9 @@ def cpu_join_baseline(n_rows: int) -> float:
 
 
 def main():
-    _ensure_usable_backend()
+    from bigslice_tpu.utils.hermetic import ensure_usable_backend
+
+    ensure_usable_backend()
     mode = "reduce"
     args = sys.argv[1:]
     if args and args[0] in ("reduce", "join"):

@@ -1,0 +1,108 @@
+"""Micro-benchmarks (the reference's unrecorded Go benchmarks, §6):
+evaluator scheduling overhead, frame kernel throughputs, codec rates.
+
+Usage: python -m bigslice_tpu.tools.microbench [--quick]
+Prints one line per metric; no JSON contract (bench.py is the driver's
+headline benchmark).
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+
+def timeit(fn, iters: int = 5) -> float:
+    fn()  # warm
+    best = float("inf")
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def bench_eval(n_tasks: int = 500):
+    """Evaluator + stub executor scheduling overhead
+    (BenchmarkEval, exec/eval_test.go:583)."""
+    from bigslice_tpu.exec.evaluate import evaluate
+    from bigslice_tpu.exec.task import (
+        Partitioner, Task, TaskDep, TaskName, TaskState,
+    )
+
+    class InstantExecutor:
+        def submit(self, task):
+            if task.transition_if(TaskState.WAITING, TaskState.RUNNING):
+                task.mark_ok()
+
+    def run():
+        prev = None
+        tasks = []
+        for i in range(n_tasks):
+            deps = [TaskDep((prev,), 0)] if prev is not None else []
+            t = Task(TaskName(1, f"t{i}", 0, 1),
+                     lambda f: iter(()), deps, Partitioner(), None)
+            tasks.append(t)
+            prev = t
+        evaluate(InstantExecutor(), [tasks[-1]])
+
+    dt = timeit(run, 3)
+    print(f"eval_chain        {n_tasks} tasks      "
+          f"{dt * 1e6 / n_tasks:8.1f} us/task")
+
+
+def bench_frame(n: int = 1 << 20):
+    from bigslice_tpu.frame.frame import Frame
+
+    f = Frame([np.arange(n, dtype=np.int32),
+               np.random.RandomState(0).rand(n).astype(np.float32)])
+    dt = timeit(lambda: f.hash_keys())
+    print(f"frame_hash        {n} rows     {n / dt / 1e6:8.1f} Mrows/s")
+    dt = timeit(lambda: f.partition_ids(16))
+    print(f"frame_partition   {n} rows     {n / dt / 1e6:8.1f} Mrows/s")
+    dt = timeit(lambda: f.sorted_by_key())
+    print(f"frame_sort        {n} rows     {n / dt / 1e6:8.1f} Mrows/s")
+
+
+def bench_codec(n: int = 1 << 18):
+    from bigslice_tpu.frame import codec
+    from bigslice_tpu.frame.frame import Frame
+
+    f = Frame([np.arange(n, dtype=np.int32),
+               np.random.RandomState(0).rand(n).astype(np.float32)])
+    blob = codec.encode_frame(f)
+    dt = timeit(lambda: codec.encode_frame(f))
+    print(f"codec_encode      {n} rows      {n / dt / 1e6:8.1f} Mrows/s "
+          f"({len(blob) / 1e6:.1f} MB)")
+    dt = timeit(lambda: codec.decode_frame(blob))
+    print(f"codec_decode      {n} rows      {n / dt / 1e6:8.1f} Mrows/s")
+
+
+def bench_device_reduce(n: int = 1 << 19):
+    from bigslice_tpu.parallel import segment
+
+    keys = np.random.RandomState(0).randint(0, 1 << 12, n).astype(np.int32)
+    vals = np.ones(n, np.int32)
+    red = segment.DeviceReduceByKey(lambda a, b: a + b, 1, 1)
+    dt = timeit(lambda: red([keys], [vals], n))
+    print(f"device_reduce     {n} rows      {n / dt / 1e6:8.1f} Mrows/s")
+
+
+def main(argv=None) -> int:
+    from bigslice_tpu.utils.hermetic import ensure_usable_backend
+
+    ensure_usable_backend()
+    argv = argv if argv is not None else sys.argv[1:]
+    quick = "--quick" in argv
+    scale = 4 if quick else 1
+    bench_eval(200 if quick else 500)
+    bench_frame((1 << 20) // scale)
+    bench_codec((1 << 18) // scale)
+    bench_device_reduce((1 << 19) // scale)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -22,3 +22,29 @@ def force_hermetic_cpu() -> None:
         jax.config.update("jax_platforms", "cpu")
     except Exception:
         pass
+
+
+def ensure_usable_backend(timeout: float = 90.0) -> str:
+    """Probe device init in a subprocess; a wedged TPU tunnel hangs
+    inside native code (unkillable in-process), so probe out-of-process
+    and fall back to hermetic CPU rather than hanging the caller.
+    Returns "default" (healthy) or "cpu-fallback"."""
+    import os
+    import subprocess
+    import sys
+
+    if os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip() == "cpu":
+        # Already pinned to CPU (tests, hermetic tools): nothing to probe.
+        force_hermetic_cpu()
+        return "cpu"
+    try:
+        subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout, capture_output=True, check=True,
+        )
+        return "default"
+    except (subprocess.TimeoutExpired, subprocess.CalledProcessError):
+        print("bigslice_tpu: device backend unavailable (tunnel hang?); "
+              "falling back to CPU", file=sys.stderr)
+        force_hermetic_cpu()
+        return "cpu-fallback"

@@ -222,3 +222,16 @@ def test_registry_digest_stable():
         return bs.Const(1, [1])
 
     assert func_mod.registry_digest() != d1
+
+
+def test_microbench_tool(capsys):
+    # Tiny sizes: this is a smoke of the tool's plumbing, not a real
+    # measurement (the CLI with --quick is the manual surface).
+    from bigslice_tpu.tools import microbench
+
+    microbench.bench_eval(20)
+    microbench.bench_frame(1 << 10)
+    microbench.bench_codec(1 << 8)
+    microbench.bench_device_reduce(1 << 10)
+    out = capsys.readouterr().out
+    assert "eval_chain" in out and "device_reduce" in out
