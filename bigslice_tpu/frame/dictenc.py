@@ -48,20 +48,28 @@ def decode_column(codes, vocab: Sequence) -> np.ndarray:
 
 class GlobalVocab:
     """A shared vocabulary for cross-shard encoded work: build once on
-    the host (or incrementally), encode anywhere, decode at the edges."""
+    the host (or incrementally), encode anywhere, decode at the edges.
+
+    ``extend`` is thread-safe: vocabulary passes often run inside
+    parallel shard tasks (models/urls.py), and an unlocked check-then-
+    insert could assign one code to two values."""
 
     def __init__(self, values: Sequence = ()):
+        import threading
+
+        self._lock = threading.Lock()
         self._index: Dict = {}
         self._values: List = []
         self._lookup = None  # cached decode array
         self.extend(values)
 
     def extend(self, values: Sequence) -> None:
-        for v in values:
-            if v not in self._index:
-                self._index[v] = len(self._values)
-                self._values.append(v)
-        self._lookup = None
+        with self._lock:
+            for v in values:
+                if v not in self._index:
+                    self._index[v] = len(self._values)
+                    self._values.append(v)
+            self._lookup = None
 
     def __len__(self) -> int:
         return len(self._values)

@@ -10,6 +10,6 @@ Access pipelines as ``models.wordcount.wordcount(...)``,
 re-exported at package level to avoid shadowing the submodules.
 """
 
-from bigslice_tpu.models import kmeans, maxint, wordcount
+from bigslice_tpu.models import kmeans, maxint, urls, wordcount
 
-__all__ = ["kmeans", "maxint", "wordcount"]
+__all__ = ["kmeans", "maxint", "urls", "wordcount"]

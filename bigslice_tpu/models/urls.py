@@ -1,0 +1,62 @@
+"""Domain counting over a line corpus — the reference's demo program
+(cmd/urls/urls.go:5-37: GDELT domain count = ReaderFunc → Map → Reduce).
+
+Two variants:
+- ``domain_count``: the straight port shape — host-tier parsing, string
+  keys end-to-end.
+- ``domain_count_encoded``: the TPU-recommended shape — one host pass
+  builds a domain vocabulary, then counting runs on the device tier via
+  surrogate keys (frame/dictenc.py), decoding at the edge.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Tuple, Union
+
+import numpy as np
+
+import bigslice_tpu as bs
+
+
+def _domain(url: str) -> str:
+    url = url.split("//", 1)[-1]
+    return url.split("/", 1)[0].lower()
+
+
+def domain_count(num_shards: int, source: Union[str, Callable]) -> bs.Slice:
+    """Count URLs per domain (host-tier strings)."""
+    lines = bs.ScanReader(num_shards, source)
+    pairs = bs.Map(lines, lambda u: (_domain(u), 1),
+                   out=[str, np.int32])
+    return bs.Reduce(pairs, lambda a, b: a + b)
+
+
+def domain_count_encoded(sess, num_shards: int,
+                         source: Union[str, Callable]
+                         ) -> List[Tuple[str, int]]:
+    """Count URLs per domain with device-tier counting.
+
+    Pass 1 (host, streaming): collect the domain vocabulary.
+    Pass 2: encode per batch (vectorized) and Reduce on device.
+    """
+    from bigslice_tpu.frame import dictenc
+
+    lines = bs.ScanReader(num_shards, source)
+    vocab = dictenc.GlobalVocab()
+
+    def collect(shard, frame):
+        vocab.extend(_domain(u) for u in frame.cols[0])
+
+    # Vocabulary pass: materializing the WriterFunc drives every batch
+    # through `collect` — and the Result keeps the corpus, so pass 2
+    # reuses it instead of re-reading the source (ScanReader striping
+    # would otherwise cost num_shards full scans again).
+    corpus = sess.run(bs.WriterFunc(lines, collect))
+    try:
+        pairs = bs.Map(corpus, lambda u: (_domain(u), 1),
+                       out=[str, np.int32])
+        return dictenc.dict_encoded_reduce(
+            sess, pairs, lambda a, b: a + b, vocab
+        )
+    finally:
+        corpus.discard()

@@ -84,3 +84,49 @@ def test_kmeans_slice_api_converges():
     cents = kmeans_mod.kmeans(sess, pts, k=3, iters=5, num_shards=3)
     centers = sorted(round(float(c[0]) / 10) for c in cents)
     assert centers == [0, 1, 2]
+
+
+def test_urls_domain_count(tmp_path):
+    import bigslice_tpu.models.urls as urls_mod
+
+    p = tmp_path / "urls.txt"
+    p.write_text(
+        "http://a.com/x\nhttps://b.org/y\nhttp://A.com/z\n"
+        "https://b.org/\nhttp://c.net\n"
+    )
+    got = dict(slicetest.scan_all(urls_mod.domain_count(3, str(p))))
+    assert got == {"a.com": 2, "b.org": 2, "c.net": 1}
+
+
+def test_urls_domain_count_encoded(tmp_path):
+    import bigslice_tpu.models.urls as urls_mod
+
+    p = tmp_path / "urls.txt"
+    lines = [f"http://site{i % 7}.com/page{i}" for i in range(200)]
+    p.write_text("\n".join(lines) + "\n")
+    sess = Session()
+    rows = urls_mod.domain_count_encoded(sess, 4, str(p))
+    got = dict(rows)
+    expect = {}
+    for i in range(200):
+        d = f"site{i % 7}.com"
+        expect[d] = expect.get(d, 0) + 1
+    assert got == expect
+
+
+def test_kmeans_slice_api_on_mesh():
+    import jax
+    from jax.sharding import Mesh
+
+    from bigslice_tpu.exec.meshexec import MeshExecutor
+
+    rng = np.random.RandomState(6)
+    blobs = [rng.randn(40, 4).astype(np.float32) + 12 * i
+             for i in range(2)]
+    pts = np.concatenate(blobs)
+    rng.shuffle(pts)
+    mesh = Mesh(np.array(jax.devices()[:8]), ("shards",))
+    sess = Session(executor=MeshExecutor(mesh))
+    cents = kmeans_mod.kmeans(sess, pts, k=2, iters=4, num_shards=8)
+    centers = sorted(round(float(c[0]) / 12) for c in cents)
+    assert centers == [0, 1]
