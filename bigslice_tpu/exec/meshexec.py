@@ -110,7 +110,8 @@ class _GroupState:
 class MeshExecutor:
     name = "mesh"
 
-    def __init__(self, mesh, fallback_procs: Optional[int] = None):
+    def __init__(self, mesh, fallback_procs: Optional[int] = None,
+                 ordered_dispatch: bool = False):
         self.mesh = mesh
         self.nmesh = int(mesh.devices.size)
         self.store = _BridgedStore(self)
@@ -120,6 +121,19 @@ class MeshExecutor:
         self._outputs: Dict[Tuple, DeviceGroupOutput] = {}
         self._task_index: Dict[TaskName, Tuple[Tuple, Task]] = {}
         self._programs: Dict[Tuple, Tuple[object, list]] = {}
+        # Ordered dispatch: ONE dispatcher thread launches device groups
+        # strictly in the compile-time plan order the session registers
+        # (deterministic by construction — the issue-order discipline
+        # SPMD multi-host sessions need: every process must enter jitted
+        # collectives in the same order). Groups that route to the
+        # fallback path, or never materialize (already satisfied by a
+        # prior run), are cancelled/skipped from the plan.
+        self.ordered_dispatch = ordered_dispatch
+        self._plan: List[Tuple] = []
+        self._ready_set: set = set()
+        self._cancelled: set = set()
+        self._ready_cond = threading.Condition(self._lock)
+        self._dispatcher: Optional[threading.Thread] = None
 
     def start(self, session) -> None:
         self.session = session
@@ -127,8 +141,33 @@ class MeshExecutor:
 
     # -- Executor interface ----------------------------------------------
 
+    def plan_groups(self, keys) -> None:
+        """Register the deterministic launch order for upcoming device
+        groups (called by the session before evaluation when
+        ordered_dispatch is on)."""
+        if not self.ordered_dispatch:
+            return
+        with self._lock:
+            seen = set(self._plan)
+            for k in keys:
+                if k is not None and k not in seen:
+                    self._plan.append(k)
+                    seen.add(k)
+            if self._dispatcher is None:
+                self._dispatcher = threading.Thread(
+                    target=self._dispatch_loop, daemon=True
+                )
+                self._dispatcher.start()
+            self._ready_cond.notify_all()
+
     def submit(self, task: Task) -> None:
         if not self._eligible(task):
+            if self.ordered_dispatch and task.group_key is not None:
+                # The whole group shares eligibility: it will never run
+                # on the device path, so unblock the plan.
+                with self._lock:
+                    self._cancelled.add(task.group_key)
+                    self._ready_cond.notify_all()
             self.local.submit(task)
             return
         key = task.group_key
@@ -150,9 +189,14 @@ class MeshExecutor:
                 g.timer.daemon = True
                 g.timer.start()
         if complete:
-            threading.Thread(
-                target=self._run_group, args=(key,), daemon=True
-            ).start()
+            if self.ordered_dispatch:
+                with self._lock:
+                    self._ready_set.add(key)
+                    self._ready_cond.notify_all()
+            else:
+                threading.Thread(
+                    target=self._run_group, args=(key,), daemon=True
+                ).start()
 
     def device_group_count(self) -> int:
         """How many op groups have run on the device path (diagnostics;
@@ -221,6 +265,40 @@ class MeshExecutor:
 
     # -- group orchestration ----------------------------------------------
 
+    def _dispatch_loop(self) -> None:
+        while True:
+            key = None
+            with self._lock:
+                while True:
+                    while not self._plan:
+                        self._ready_cond.wait()
+                    head = self._plan[0]
+                    if head in self._cancelled:
+                        self._plan.pop(0)
+                        self._cancelled.discard(head)
+                        continue
+                    if head in self._ready_set:
+                        self._plan.pop(0)
+                        self._ready_set.discard(head)
+                        key = head
+                        break
+                    # Head not ready yet. It may never arrive (all its
+                    # tasks satisfied by a prior run): after a grace
+                    # period with no sign of it, skip — such groups run
+                    # no collectives on any process, so skipping is
+                    # cross-process consistent.
+                    if not self._ready_cond.wait(timeout=GROUP_WAIT_SECS):
+                        if (head not in self._ready_set
+                                and head not in self._groups):
+                            self._plan.pop(0)
+                            self._cancelled.discard(head)
+            try:
+                self._run_group(key)
+            except Exception:  # noqa: BLE001 — keep the dispatcher alive
+                # _run_group reports task state itself; a raise here
+                # must not kill the only dispatcher.
+                pass
+
     def _flush_stragglers(self, key) -> None:
         with self._lock:
             g = self._groups.get(key)
@@ -229,6 +307,9 @@ class MeshExecutor:
             g.launched = True
             del self._groups[key]
             tasks = list(g.tasks.values())
+            # Unblock an ordered plan promptly: this group runs fallback.
+            self._cancelled.add(key)
+            self._ready_cond.notify_all()
         for t in tasks:
             self.local.submit(t)
 

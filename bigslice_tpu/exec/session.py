@@ -224,6 +224,17 @@ class Session:
         ).compile(slice_)
         if self.debug is not None:
             self.debug.register_roots(tasks)
+        plan_groups = getattr(self.executor, "plan_groups", None)
+        if plan_groups is not None:
+            from bigslice_tpu.exec.task import iter_tasks
+
+            # Post-order DFS is deterministic given the same program —
+            # the ordered dispatcher's cross-process launch sequence.
+            seen = []
+            for t in iter_tasks(tasks):
+                if t.group_key is not None and t.group_key not in seen:
+                    seen.append(t.group_key)
+            plan_groups(seen)
         # Exclusive invocations evaluate in isolation from concurrent
         # runs of this session; their own shards stay parallel.
         self._gate.acquire(exclusive)
