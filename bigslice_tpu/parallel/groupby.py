@@ -1,0 +1,127 @@
+"""Fixed-capacity device grouping: ragged groups as (matrix, counts).
+
+The general Cogroup materializes ragged per-key lists on the host
+(ops/cogroup.py). When the group size is bounded (or a bounded sample
+per key suffices), grouping lowers to the device as the classic
+fixed-capacity encoding (SURVEY.md §7.3(1) pad/overflow strategy):
+
+    keys:   int32[n_keys]
+    values: dtype[n_keys, G]   (rows beyond a key's count are padding)
+    counts: int32[n_keys]      (true group size, may exceed G; only the
+                               first G values are kept)
+
+Mechanics (one jitted program): sort rows by key, segment offsets by
+running position within each segment, scatter into the (max_keys, G)
+matrix, with per-key counts from segment sums. Overflowing rows are
+dropped deterministically (the sorted order's tail) and visible via
+counts > G.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from bigslice_tpu.parallel.jitutil import bucket_size, pad_cols
+
+
+class DeviceGroupByKey:
+    """Jitted fixed-capacity grouping over device columns.
+
+    ``__call__(key_cols, val_col, n)`` → (keys int32[k], groups
+    dtype[k, G], counts int32[k]) host-compacted, sorted by key.
+    """
+
+    def __init__(self, nkeys: int, capacity: int):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        self.nkeys = nkeys
+        self.capacity = capacity
+        G = capacity
+
+        def kernel(n, *cols):
+            keys = cols[:nkeys]
+            val = cols[nkeys]
+            size = val.shape[0]
+            invalid = (jnp.arange(size, dtype=np.int32) >= n).astype(
+                np.int32
+            )
+            ops = (invalid,) + tuple(keys) + (val,)
+            s = lax.sort(ops, num_keys=1 + nkeys, is_stable=True)
+            s_invalid = s[0]
+            s_keys = s[1 : 1 + nkeys]
+            s_val = s[1 + nkeys]
+
+            diff = jnp.zeros(size, dtype=bool).at[0].set(True)
+            for k in (s_invalid,) + tuple(s_keys):
+                diff = diff.at[1:].set(diff[1:] | (k[1:] != k[:-1]))
+            diff = diff | (s_invalid == 1)
+
+            seg_id = jnp.cumsum(diff.astype(np.int32)) - 1  # [size]
+            # Position within segment: global index − segment start.
+            starts = jnp.where(diff, jnp.arange(size, dtype=np.int32), 0)
+            seg_start = jax.lax.associative_scan(jnp.maximum, starts)
+            pos = jnp.arange(size, dtype=np.int32) - seg_start
+
+            valid_row = (s_invalid == 0)
+            in_cap = valid_row & (pos < G)
+            drop_lane = size  # scatter drop row
+            dest_seg = jnp.where(in_cap, seg_id, drop_lane)
+            dest_pos = jnp.where(in_cap, pos, 0)
+            groups = jnp.zeros((size + 1, G), val.dtype)
+            groups = groups.at[dest_seg, dest_pos].set(s_val, mode="drop")
+            groups = groups[:size]
+
+            counts = jnp.zeros((size + 1,), np.int32)
+            counts = counts.at[jnp.where(valid_row, seg_id, drop_lane)
+                               ].add(1, mode="drop")
+            counts = counts[:size]
+
+            # One representative row per segment (its first row) carries
+            # the key; compact segments to the front via the shared
+            # helper (parallel/segment.py).
+            from bigslice_tpu.parallel.segment import compact_by_mask
+
+            is_seg_first = diff & valid_row
+            n_groups, packed = compact_by_mask(
+                is_seg_first,
+                (jnp.arange(size, dtype=np.int32),) + tuple(s_keys),
+            )
+            first_idx = packed[0]
+            out_keys = packed[1:]
+            seg_of_first = seg_id[first_idx]
+            out_groups = groups[seg_of_first]
+            out_counts = counts[seg_of_first]
+            return n_groups, out_keys, out_groups, out_counts
+
+        self._jitted = jax.jit(kernel)
+
+    def __call__(self, key_cols: Sequence, val_col, n: int):
+        import jax.numpy as jnp
+
+        size = bucket_size(n)
+        cols = pad_cols(list(key_cols) + [val_col], n, size)
+        k, keys, groups, counts = self._jitted(jnp.int32(n), *cols)
+        k = int(k)
+        return (
+            [np.asarray(c)[:k] for c in keys],
+            np.asarray(groups)[:k],
+            np.asarray(counts)[:k],
+        )
+
+
+_GROUPBY_CACHE: dict = {}
+
+
+def cached_group_by_key(nkeys: int, capacity: int) -> DeviceGroupByKey:
+    """Shared instances per (nkeys, capacity) — repeated construction
+    must not recompile (no user fn in the key, unlike the combiner
+    caches)."""
+    key = (nkeys, capacity)
+    kern = _GROUPBY_CACHE.get(key)
+    if kern is None:
+        kern = _GROUPBY_CACHE[key] = DeviceGroupByKey(nkeys, capacity)
+    return kern

@@ -1,0 +1,59 @@
+"""Fixed-capacity device grouping tests."""
+
+import numpy as np
+import pytest
+
+from bigslice_tpu.parallel.groupby import DeviceGroupByKey
+
+
+def oracle_groups(keys, vals):
+    out = {}
+    for k, v in zip(keys, vals):
+        out.setdefault(k, []).append(v)
+    return out
+
+
+def test_group_by_key_basic():
+    keys = np.array([3, 1, 3, 2, 1, 3], np.int32)
+    vals = np.array([30, 10, 31, 20, 11, 32], np.int32)
+    g = DeviceGroupByKey(nkeys=1, capacity=4)
+    (ok,), groups, counts = g([keys], vals, len(keys))
+    oracle = oracle_groups(keys.tolist(), vals.tolist())
+    assert ok.tolist() == sorted(oracle)
+    for i, k in enumerate(ok.tolist()):
+        assert counts[i] == len(oracle[k])
+        assert sorted(groups[i][: counts[i]].tolist()) == sorted(oracle[k])
+
+
+def test_group_by_key_overflow_visible():
+    keys = np.zeros(10, np.int32)
+    vals = np.arange(10, dtype=np.int32)
+    g = DeviceGroupByKey(nkeys=1, capacity=4)
+    (ok,), groups, counts = g([keys], vals, 10)
+    assert ok.tolist() == [0]
+    assert counts[0] == 10  # true size visible despite capacity 4
+    assert len(set(groups[0].tolist())) == 4  # first G kept
+
+
+@pytest.mark.parametrize("n", [1, 5, 64, 1000])
+def test_group_by_key_random(n):
+    rng = np.random.RandomState(n)
+    keys = rng.randint(0, max(2, n // 4), n).astype(np.int32)
+    vals = rng.randint(0, 1000, n).astype(np.int32)
+    g = DeviceGroupByKey(nkeys=1, capacity=64)
+    (ok,), groups, counts = g([keys], vals, n)
+    oracle = oracle_groups(keys.tolist(), vals.tolist())
+    assert ok.tolist() == sorted(oracle)
+    for i, k in enumerate(ok.tolist()):
+        want = oracle[k]
+        assert counts[i] == len(want)
+        kept = groups[i][: min(len(want), 64)].tolist()
+        assert set(kept) <= set(want)
+        assert len(kept) == min(len(want), 64)
+
+
+def test_group_by_key_empty():
+    g = DeviceGroupByKey(nkeys=1, capacity=8)
+    (ok,), groups, counts = g([np.zeros(0, np.int32)],
+                              np.zeros(0, np.int32), 0)
+    assert len(ok) == 0 and len(groups) == 0 and len(counts) == 0
