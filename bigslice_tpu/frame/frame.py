@@ -62,7 +62,11 @@ def _infer_coltype(col) -> ColType:
     # corrupt hashing, which assumes ≤4-byte lanes).
     from bigslice_tpu.slicetype import coltype
 
-    return coltype(dt)
+    ct = coltype(dt)
+    shape = tuple(getattr(col, "shape", (0,))[1:])
+    if shape:
+        ct = ColType(ct.dtype, ct.tag, shape)
+    return ct
 
 
 class Frame:
@@ -126,7 +130,8 @@ class Frame:
     @staticmethod
     def empty(schema: Schema) -> "Frame":
         cols = [
-            np.empty(0, dtype=ct.dtype if ct.is_device else object)
+            np.empty((0,) + ct.shape,
+                     dtype=ct.dtype if ct.is_device else object)
             for ct in schema
         ]
         return Frame(cols, schema)

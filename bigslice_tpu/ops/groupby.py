@@ -1,0 +1,77 @@
+"""GroupByKey — device-tier grouping with fixed capacity.
+
+The ragged-group combinator family:
+- ``Cogroup`` (ops/cogroup.py): exact, host-tier, unbounded group sizes
+  (Python lists) — the reference's semantics.
+- ``GroupByKey`` (here): TPU-native — groups encode as a fixed-capacity
+  matrix column plus a true-count column (SURVEY.md §7.3(1) strategy),
+  produced entirely on the device by the parallel/groupby.py kernel.
+  The first ``capacity`` values per key (in shuffle arrival order
+  post-sort) are kept; ``count`` stays exact so overflow is visible.
+
+Output schema: (key..., group dtype[capacity] matrix column, count
+int32), prefix = input prefix. Matrix columns are ordinary device
+columns with a trailing dimension; downstream traceable Maps receive a
+[capacity]-shaped vector per row.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from bigslice_tpu import typecheck
+from bigslice_tpu.slicetype import ColType, Schema
+from bigslice_tpu.frame.frame import Frame
+from bigslice_tpu.ops.base import Dep, Slice, make_name
+from bigslice_tpu.parallel.groupby import cached_group_by_key
+
+
+class GroupByKey(Slice):
+    """``GroupByKey(slice, capacity)`` over a (key..., value) slice with
+    exactly one device value column."""
+
+    def __init__(self, slice_: Slice, capacity: int):
+        typecheck.check(capacity >= 1, "groupbykey: capacity must be >= 1")
+        typecheck.check(
+            slice_.prefix >= 1,
+            "groupbykey: input slice must have a key prefix",
+        )
+        typecheck.check(
+            len(slice_.schema) == slice_.prefix + 1,
+            "groupbykey: input must have exactly one value column "
+            "(got %d)", len(slice_.schema) - slice_.prefix,
+        )
+        typecheck.check(
+            all(ct.is_device for ct in slice_.schema),
+            "groupbykey: all columns must be device-tier "
+            "(dictionary-encode host keys first)",
+        )
+        val = slice_.schema.cols[slice_.prefix]
+        schema = Schema(
+            list(slice_.schema.key)
+            + [ColType(val.dtype, shape=(capacity,)), ColType(np.int32)],
+            prefix=slice_.prefix,
+        )
+        super().__init__(schema, slice_.num_shards, make_name("groupby"),
+                         pragmas=slice_.pragmas)
+        self.dep_slice = slice_
+        self.capacity = capacity
+
+    def deps(self):
+        return (Dep(self.dep_slice, shuffle=True),)
+
+    def reader(self, shard, deps):
+        def read():
+            from bigslice_tpu import sliceio
+
+            frame = sliceio.read_all(deps[0](), self.dep_slice.schema)
+            if not len(frame):
+                return
+            host = frame.to_host()
+            kern = cached_group_by_key(self.prefix, self.capacity)
+            keys, groups, counts = kern(
+                list(host.key_cols()), host.value_cols()[0], len(host)
+            )
+            yield Frame(list(keys) + [groups, counts], self.schema)
+
+        return read()

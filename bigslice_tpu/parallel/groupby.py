@@ -43,58 +43,46 @@ class DeviceGroupByKey:
         G = capacity
 
         def kernel(n, *cols):
+            from bigslice_tpu.parallel.segment import (
+                compact_by_mask,
+                sort_and_segment,
+            )
+
             keys = cols[:nkeys]
             val = cols[nkeys]
             size = val.shape[0]
-            invalid = (jnp.arange(size, dtype=np.int32) >= n).astype(
-                np.int32
+            mask = jnp.arange(size, dtype=np.int32) < n
+            s_invalid, s_keys, (s_val,), diff = sort_and_segment(
+                nkeys, mask, keys, (val,)
             )
-            ops = (invalid,) + tuple(keys) + (val,)
-            s = lax.sort(ops, num_keys=1 + nkeys, is_stable=True)
-            s_invalid = s[0]
-            s_keys = s[1 : 1 + nkeys]
-            s_val = s[1 + nkeys]
-
-            diff = jnp.zeros(size, dtype=bool).at[0].set(True)
-            for k in (s_invalid,) + tuple(s_keys):
-                diff = diff.at[1:].set(diff[1:] | (k[1:] != k[:-1]))
-            diff = diff | (s_invalid == 1)
-
-            seg_id = jnp.cumsum(diff.astype(np.int32)) - 1  # [size]
-            # Position within segment: global index − segment start.
-            starts = jnp.where(diff, jnp.arange(size, dtype=np.int32), 0)
-            seg_start = jax.lax.associative_scan(jnp.maximum, starts)
-            pos = jnp.arange(size, dtype=np.int32) - seg_start
-
             valid_row = (s_invalid == 0)
-            in_cap = valid_row & (pos < G)
-            drop_lane = size  # scatter drop row
-            dest_seg = jnp.where(in_cap, seg_id, drop_lane)
-            dest_pos = jnp.where(in_cap, pos, 0)
-            groups = jnp.zeros((size + 1, G), val.dtype)
-            groups = groups.at[dest_seg, dest_pos].set(s_val, mode="drop")
-            groups = groups[:size]
 
-            counts = jnp.zeros((size + 1,), np.int32)
-            counts = counts.at[jnp.where(valid_row, seg_id, drop_lane)
-                               ].add(1, mode="drop")
-            counts = counts[:size]
-
-            # One representative row per segment (its first row) carries
-            # the key; compact segments to the front via the shared
-            # helper (parallel/segment.py).
-            from bigslice_tpu.parallel.segment import compact_by_mask
-
+            idx = jnp.arange(size, dtype=np.int32)
             is_seg_first = diff & valid_row
             n_groups, packed = compact_by_mask(
-                is_seg_first,
-                (jnp.arange(size, dtype=np.int32),) + tuple(s_keys),
+                is_seg_first, (idx,) + tuple(s_keys)
             )
             first_idx = packed[0]
             out_keys = packed[1:]
-            seg_of_first = seg_id[first_idx]
-            out_groups = groups[seg_of_first]
-            out_counts = counts[seg_of_first]
+
+            # Rows of a segment are contiguous post-sort: gather a [k, G]
+            # window starting at each segment head (clipped), masked by
+            # the true segment length — no O(size*G) scatter matrices.
+            seg_len_all = jnp.zeros((size + 1,), np.int32).at[
+                jnp.where(valid_row,
+                          jnp.cumsum(diff.astype(np.int32)) - 1, size)
+            ].add(1, mode="drop")[:size]
+            seg_id_of_first = jnp.cumsum(diff.astype(np.int32))[first_idx] - 1
+            out_counts = seg_len_all[seg_id_of_first]
+            offsets = jnp.minimum(
+                first_idx[:, None] + jnp.arange(G, dtype=np.int32)[None, :],
+                size - 1,
+            )
+            gathered = s_val[offsets]
+            in_group = (jnp.arange(G, dtype=np.int32)[None, :]
+                        < jnp.minimum(out_counts, G)[:, None])
+            out_groups = jnp.where(in_group, gathered,
+                                   jnp.zeros((), val.dtype))
             return n_groups, out_keys, out_groups, out_counts
 
         self._jitted = jax.jit(kernel)

@@ -41,6 +41,28 @@ def canonical_combine(fn: Callable, nvals: int) -> Callable:
     return cfn
 
 
+def sort_and_segment(nkeys: int, valid_mask, key_cols, payload):
+    """Shared prelude for keyed kernels: stable-sort rows by (validity,
+    keys) with payload columns riding along, and mark segment starts
+    (row 0, any key change, validity change; invalid rows isolate into
+    their own segments). Returns (s_invalid, s_keys, s_payload, diff)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    size = key_cols[0].shape[0]
+    invalid = (~valid_mask).astype(np.int32)
+    ops = (invalid,) + tuple(key_cols) + tuple(payload)
+    s = lax.sort(ops, num_keys=1 + nkeys, is_stable=True)
+    s_invalid = s[0]
+    s_keys = s[1 : 1 + nkeys]
+    s_payload = s[1 + nkeys :]
+    diff = jnp.zeros(size, dtype=bool).at[0].set(True)
+    for k in (s_invalid,) + tuple(s_keys):
+        diff = diff.at[1:].set(diff[1:] | (k[1:] != k[:-1]))
+    diff = diff | (s_invalid == 1)
+    return s_invalid, s_keys, s_payload, diff
+
+
 def compact_by_mask(mask, cols):
     """Front-compact rows selected by ``mask`` (stable; preserves the
     relative order of survivors). Returns (count, cols). The one shared
@@ -70,17 +92,9 @@ def make_segmented_reduce_masked(nkeys: int, nvals: int, cfn,
 
     def core(valid_mask, key_cols, val_cols):
         size = key_cols[0].shape[0]
-        invalid = (~valid_mask).astype(np.int32)
-        ops = (invalid,) + tuple(key_cols) + tuple(val_cols)
-        s = lax.sort(ops, num_keys=1 + nkeys, is_stable=True)
-        s_invalid = s[0]
-        s_keys = s[1 : 1 + nkeys]
-        s_vals = s[1 + nkeys :]
-
-        diff = jnp.zeros(size, dtype=bool).at[0].set(True)
-        for k in (s_invalid,) + tuple(s_keys):
-            diff = diff.at[1:].set(diff[1:] | (k[1:] != k[:-1]))
-        diff = diff | (s_invalid == 1)
+        s_invalid, s_keys, s_vals, diff = sort_and_segment(
+            nkeys, valid_mask, key_cols, val_cols
+        )
 
         def scan_op(x, y):
             fx, vx = x

@@ -42,14 +42,18 @@ class ColType:
 
     ``dtype`` is a numpy dtype for device columns, or ``np.dtype(object)``
     for host columns. ``tag`` optionally names the host payload kind
-    (e.g. "str") for nicer error messages.
+    (e.g. "str"). ``shape`` is the per-row trailing shape — () for
+    scalar columns, (G,) for fixed-width vector columns (GroupByKey's
+    group matrices).
     """
 
     dtype: np.dtype
     tag: str = ""
+    shape: tuple = ()
 
     def __post_init__(self):
         object.__setattr__(self, "dtype", np.dtype(self.dtype))
+        object.__setattr__(self, "shape", tuple(self.shape))
 
     @property
     def is_device(self) -> bool:
@@ -62,6 +66,8 @@ class ColType:
     def __repr__(self) -> str:
         if self.is_host:
             return f"host[{self.tag or 'object'}]"
+        if self.shape:
+            return f"{self.dtype}{list(self.shape)}"
         return str(self.dtype)
 
 

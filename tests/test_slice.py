@@ -505,3 +505,43 @@ def test_exclusive_func_isolates_invocation():
             assert ee <= ns or ne <= es, "exclusive run overlapped normal"
     # The user's shared slice was never contaminated.
     assert not shared.exclusive
+
+
+def test_groupbykey_device(sess):
+    rng = np.random.RandomState(9)
+    keys = rng.randint(0, 12, 300).astype(np.int32)
+    vals = rng.randint(0, 1000, 300).astype(np.int32)
+    g = bs.GroupByKey(bs.Const(4, keys, vals), capacity=64)
+    rows = slicetest.scan_all(g, session=sess)
+    oracle = {}
+    for k, v in zip(keys.tolist(), vals.tolist()):
+        oracle.setdefault(k, []).append(v)
+    assert sorted(k for k, _, _ in rows) == sorted(oracle)
+    for k, group, count in rows:
+        assert count == len(oracle[k])
+        assert sorted(np.asarray(group)[:count].tolist()) == sorted(
+            oracle[k]
+        )
+
+
+def test_groupbykey_feeds_traceable_map(sess):
+    """Group matrices flow into vmapped Maps as per-row vectors."""
+    import jax.numpy as jnp
+
+    keys = np.array([1, 1, 2, 2, 2, 3], np.int32)
+    vals = np.array([4, 6, 1, 2, 3, 9], np.int32)
+    g = bs.GroupByKey(bs.Const(2, keys, vals), capacity=8)
+    sums = bs.Map(
+        g,
+        lambda k, group, count: (
+            k,
+            jnp.where(jnp.arange(8) < count, group, 0).sum(),
+        ),
+    )
+    rows = dict(slicetest.scan_all(sums, session=sess))
+    assert rows == {1: 10, 2: 6, 3: 9}
+
+
+def test_groupbykey_rejects_host_columns():
+    with pytest.raises(typecheck.TypecheckError):
+        bs.GroupByKey(bs.Const(2, ["a", "b"], [1, 2]), capacity=4)
