@@ -6,7 +6,7 @@ need no codec (raw XLA collectives); this codec serves the host tier: spill
 files, shard caches, and cross-host result shipping.
 
 Format (little-endian):
-  magic   4s   b"BSF2"
+  magic   4s   b"BSF3"
   blen    u64  body length
   crc32   u32  over the body (validated *before* any parsing)
   body:
@@ -14,6 +14,7 @@ Format (little-endian):
     per column: kind u8 (0=numeric npy, 1=object pickle),
                 taglen u16 + tag utf-8 (ColType tag, so custom
                 register_ops semantics survive a file round-trip),
+                ndim u8 + ndim*u32 trailing dims (vector columns),
                 len u64, bytes
 """
 
@@ -30,7 +31,7 @@ import numpy as np
 from bigslice_tpu.frame.frame import Frame
 from bigslice_tpu.slicetype import Schema
 
-MAGIC = b"BSF2"
+MAGIC = b"BSF3"
 
 
 class CorruptionError(IOError):
@@ -53,6 +54,9 @@ def encode_frame(frame: Frame) -> bytes:
         tag = ct.tag.encode("utf-8")
         body.write(struct.pack("<BH", kind, len(tag)))
         body.write(tag)
+        body.write(struct.pack("<B", len(ct.shape)))
+        for d in ct.shape:
+            body.write(struct.pack("<I", d))
         body.write(struct.pack("<Q", len(payload)))
         body.write(payload)
     payload = body.getvalue()
@@ -77,11 +81,17 @@ def decode_frame(data: bytes, offset: int = 0) -> tuple:
     pos += 12
     cols: List[np.ndarray] = []
     tags: List[str] = []
+    shapes: List[tuple] = []
     for _ in range(ncols):
         kind, taglen = struct.unpack_from("<BH", data, pos)
         pos += 3
         tags.append(data[pos : pos + taglen].decode("utf-8"))
         pos += taglen
+        (ndim,) = struct.unpack_from("<B", data, pos)
+        pos += 1
+        dims = struct.unpack_from(f"<{ndim}I", data, pos) if ndim else ()
+        pos += 4 * ndim
+        shapes.append(tuple(dims))
         (plen,) = struct.unpack_from("<Q", data, pos)
         pos += 8
         payload = data[pos : pos + plen]
@@ -99,7 +109,9 @@ def decode_frame(data: bytes, offset: int = 0) -> tuple:
     from bigslice_tpu.slicetype import ColType
 
     schema = Schema(
-        [ColType(c.dtype, tag) for c, tag in zip(cols, tags)], prefix
+        [ColType(c.dtype, tag, shape)
+         for c, tag, shape in zip(cols, tags, shapes)],
+        prefix,
     )
     return Frame(cols, schema), end
 

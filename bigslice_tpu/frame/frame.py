@@ -122,7 +122,11 @@ class Frame:
         for i, ct in enumerate(schema):
             vals = [r[i] for r in rows]
             if ct.is_device:
-                cols.append(np.asarray(vals, dtype=ct.dtype))
+                cols.append(
+                    np.asarray(vals, dtype=ct.dtype).reshape(
+                        (len(vals),) + ct.shape
+                    )
+                )
             else:
                 cols.append(obj_col(vals))
         return Frame(cols, schema)
@@ -255,11 +259,16 @@ class Frame:
     # -- row access (tests, scanners, host functions) ---------------------
 
     def row(self, i: int) -> Tuple:
-        return tuple(
-            c[i].item() if isinstance(c, np.ndarray) and c.dtype != object
-            else (c[i] if isinstance(c, np.ndarray) else c[i].item())
-            for c in self.cols
-        )
+        out = []
+        for c in self.cols:
+            v = c[i]
+            if getattr(v, "ndim", 0):
+                out.append(np.asarray(v))  # vector column cell
+            elif isinstance(c, np.ndarray) and c.dtype == object:
+                out.append(v)
+            else:
+                out.append(v.item() if hasattr(v, "item") else v)
+        return tuple(out)
 
     def rows(self) -> Iterator[Tuple]:
         host = self.to_host()

@@ -133,6 +133,10 @@ def register_ops(tag: str, ops: Ops) -> None:
 
 def ops_for(ct: ColType) -> Ops:
     if ct.is_device:
+        if getattr(ct, "shape", ()) != ():
+            # Vector columns (GroupByKey matrices) are payload-only:
+            # they can't serve as shuffle/sort keys.
+            return Ops(can_hash=False, can_compare=False)
         return Ops(can_hash=True, can_compare=True)
     if ct.tag in _REGISTRY:
         return _REGISTRY[ct.tag]

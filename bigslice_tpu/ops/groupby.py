@@ -46,6 +46,11 @@ class GroupByKey(Slice):
             "groupbykey: all columns must be device-tier "
             "(dictionary-encode host keys first)",
         )
+        typecheck.check(
+            all(ct.shape == () for ct in slice_.schema),
+            "groupbykey: input columns must be scalar (vector columns "
+            "cannot ride the sort kernel)",
+        )
         val = slice_.schema.cols[slice_.prefix]
         schema = Schema(
             list(slice_.schema.key)

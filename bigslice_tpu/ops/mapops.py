@@ -51,17 +51,18 @@ def _try_trace(fn: Callable, in_schema: Schema, extra: tuple = ()):
         import jax
         import jax.numpy as jnp
 
-        specs = [jax.ShapeDtypeStruct((), ct.dtype) for ct in in_schema]
+        # Per-row avals carry each column's trailing shape (vector
+        # columns, e.g. GroupByKey matrices, present as [G] per row).
+        specs = [jax.ShapeDtypeStruct(ct.shape, ct.dtype)
+                 for ct in in_schema]
         especs = [jax.ShapeDtypeStruct(jnp.shape(e), jnp.asarray(e).dtype)
                   for e in extra]
         out = jax.eval_shape(fn, *(specs + especs))
         if not isinstance(out, (tuple, list)):
             out = (out,)
-        cols = []
-        for o in out:
-            if getattr(o, "shape", None) != ():
-                return None
-            cols.append(ColType(np.dtype(o.dtype)))
+        cols = [
+            ColType(np.dtype(o.dtype), shape=tuple(o.shape)) for o in out
+        ]
         return Schema(cols, prefix=min(1, len(cols)))
     except Exception:
         return None
@@ -117,6 +118,16 @@ class Map(_Pipelined):
                     raise typecheck.errorf(
                         "map: jax-traceable function cannot produce host "
                         "columns; declare mode='host'"
+                    )
+                if tuple(c.shape for c in schema) != tuple(
+                    c.shape for c in traced
+                ):
+                    # Declared out= types are shape-agnostic; the traced
+                    # trailing shapes are authoritative.
+                    schema = Schema(
+                        [ColType(d.dtype, d.tag, t.shape)
+                         for d, t in zip(schema, traced)],
+                        schema.prefix,
                     )
                 if tuple(c.dtype for c in schema) != tuple(
                     c.dtype for c in traced
@@ -226,9 +237,12 @@ class Filter(_Pipelined):
         if mode in ("auto", "jax"):
             traced = _try_trace(pred, slice_.schema)
         if traced is not None:
-            if len(traced) != 1 or traced[0].dtype != np.dtype(np.bool_):
+            if (len(traced) != 1
+                    or traced[0].dtype != np.dtype(np.bool_)
+                    or traced[0].shape != ()):
                 raise typecheck.errorf(
-                    "filter: predicate must return bool, got %s", traced
+                    "filter: predicate must return a scalar bool, got %s",
+                    traced,
                 )
             self.mode = "jax"
             self._vfn = get_padded_vmap(pred)

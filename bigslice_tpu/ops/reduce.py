@@ -30,6 +30,10 @@ def _vals_traceable(fn: Callable, schema: Schema) -> bool:
     """Can `fn` combine this schema's value columns on device?"""
     if not all(ct.is_device for ct in schema):
         return False
+    if any(ct.shape != () for ct in schema):
+        # The sort-based kernel carries scalar operands only; vector
+        # columns (GroupByKey outputs) combine on the host tier.
+        return False
     try:
         import jax
 

@@ -58,3 +58,54 @@ def test_group_by_key_empty():
     (ok,), groups, counts = g([np.zeros(0, np.int32)],
                               np.zeros(0, np.int32), 0)
     assert len(ok) == 0 and len(groups) == 0 and len(counts) == 0
+
+
+def test_vector_columns_edge_contracts():
+    """Vector-typed columns: codec round-trip keeps the shape, empty
+    from_rows keeps rank, row() returns arrays, keys reject vectors, and
+    nested GroupByKey is a typecheck error."""
+    import bigslice_tpu as bs
+    from bigslice_tpu import slicetest, typecheck
+    from bigslice_tpu.frame import codec
+    from bigslice_tpu.frame.frame import Frame
+    from bigslice_tpu.slicetype import ColType, Schema
+
+    schema = Schema(
+        [ColType(np.int32), ColType(np.int32, shape=(4,)),
+         ColType(np.int32)],
+        prefix=1,
+    )
+    f = Frame(
+        [np.array([1, 2], np.int32),
+         np.arange(8, dtype=np.int32).reshape(2, 4),
+         np.array([4, 4], np.int32)],
+        schema,
+    )
+    # codec round-trip preserves the vector shape
+    out, _ = codec.decode_frame(codec.encode_frame(f))
+    assert out.schema == schema and out == f
+    # empty from_rows keeps rank
+    e = Frame.from_rows([], schema)
+    assert e.cols[1].shape == (0, 4)
+    Frame.concat([e, f])  # must not raise
+    # row() yields the vector cell as an array
+    r = f.row(0)
+    assert isinstance(r[1], np.ndarray) and r[1].tolist() == [0, 1, 2, 3]
+    # vector columns can't be shuffle keys
+    from bigslice_tpu.frame import ops as frame_ops
+
+    assert not frame_ops.can_hash(schema[1])
+    # nested GroupByKey rejected at construction
+    g = bs.GroupByKey(bs.Const(2, np.array([1, 2], np.int32),
+                               np.array([3, 4], np.int32)), capacity=4)
+    with pytest.raises(typecheck.TypecheckError):
+        bs.GroupByKey(g, capacity=2)
+    # Reduce over a vector value column falls back to the host combiner
+    red = bs.Reduce(
+        bs.Map(g, lambda k, grp, c: (k % 1, grp)), lambda a, b: a + b
+    )
+    assert not red.frame_combiner.device
+    rows = slicetest.scan_all(red)
+    assert len(rows) == 1
+    # Elementwise sum of the two group vectors [3,0,0,0]+[4,0,0,0].
+    assert list(rows[0][1]) == [7, 0, 0, 0]
