@@ -139,13 +139,18 @@ def cpu_join_baseline(n_rows: int) -> float:
 def main():
     from bigslice_tpu.utils.hermetic import ensure_usable_backend
 
-    ensure_usable_backend()
+    backend = ensure_usable_backend()
+    # The headline sizes assume TPU throughput; CPU runs (pinned or
+    # wedged-tunnel fallback) scale down so the driver still gets its
+    # JSON line in bounded time.
+    fallback = backend in ("cpu", "cpu-fallback")
     mode = "reduce"
     args = sys.argv[1:]
     if args and args[0] in ("reduce", "join"):
         mode = args.pop(0)
     if mode == "join":
-        n_rows = int(args[0]) if args else 1 << 23
+        n_rows = int(args[0]) if args else (
+            1 << 19 if fallback else 1 << 23)
         dev = join_bench(n_rows)
         base = cpu_join_baseline(n_rows)
         print(json.dumps({
@@ -155,7 +160,8 @@ def main():
             "vs_baseline": round(dev / base, 3),
         }))
         return
-    n_rows = int(args[0]) if args else 1 << 24  # 16.7M
+    n_rows = int(args[0]) if args else (
+        1 << 21 if fallback else 1 << 24)  # 2M fallback / 16.7M TPU
     n_keys = 1 << 16
     rng = np.random.RandomState(42)
     keys = rng.randint(0, n_keys, n_rows).astype(np.int32)

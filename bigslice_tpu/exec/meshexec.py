@@ -220,7 +220,9 @@ class MeshExecutor:
             return False
         if task.num_partition not in (1, self.nmesh):
             return False
-        if not all(ct.is_device for ct in task.schema):
+        if not all(ct.is_device and ct.shape == ()
+                   for ct in task.schema):
+            # Vector columns can't ride the sort-based device stages.
             return False
         part = task.partitioner
         if part.combine_key or any(d.combine_key for d in task.deps):
@@ -247,7 +249,8 @@ class MeshExecutor:
         for s in task.chain:
             if isinstance(s, (Const, ReaderFunc, _PrefixedSlice,
                               Reshuffle, Reshard)):
-                if not all(ct.is_device for ct in s.schema):
+                if not all(ct.is_device and ct.shape == ()
+                           for ct in s.schema):
                     return False
                 continue
             if isinstance(s, (Map, Filter)):

@@ -272,9 +272,17 @@ class Frame:
 
     def rows(self) -> Iterator[Tuple]:
         host = self.to_host()
-        pycols = [
-            c.tolist() if c.dtype != object else list(c) for c in host.cols
-        ]
+        pycols = []
+        for c in host.cols:
+            if c.dtype == object:
+                pycols.append(list(c))
+            elif c.ndim > 1:
+                # Vector columns: per-row ndarray cells (a nested list
+                # would make host-fn arithmetic like `v + v` concatenate
+                # instead of adding elementwise).
+                pycols.append(list(c))
+            else:
+                pycols.append(c.tolist())
         return iter(zip(*pycols)) if pycols else iter(())
 
     def to_pylists(self) -> List[list]:

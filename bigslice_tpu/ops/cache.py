@@ -36,9 +36,21 @@ class ShardCache:
         self.prefix = prefix
         self.num_shards = num_shards
         self.present = [
-            os.path.exists(shard_path(prefix, s, num_shards))
+            self._usable(shard_path(prefix, s, num_shards))
             for s in range(num_shards)
         ]
+
+    @staticmethod
+    def _usable(path: str) -> bool:
+        """A cached shard counts only if it exists AND carries the
+        current codec format — files from older formats are cache
+        misses (recompute + overwrite), not runtime crashes. Mid-file
+        corruption still fails loud at read time (checksums)."""
+        try:
+            with open(path, "rb") as fp:
+                return fp.read(4) == codec.MAGIC
+        except OSError:
+            return False
 
     @property
     def all_cached(self) -> bool:

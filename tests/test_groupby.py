@@ -109,3 +109,41 @@ def test_vector_columns_edge_contracts():
     assert len(rows) == 1
     # Elementwise sum of the two group vectors [3,0,0,0]+[4,0,0,0].
     assert list(rows[0][1]) == [7, 0, 0, 0]
+
+
+def test_vector_rows_are_arrays_for_host_fns():
+    import bigslice_tpu as bs
+    from bigslice_tpu import slicetest
+
+    g = bs.GroupByKey(bs.Const(2, np.array([1, 1], np.int32),
+                               np.array([2, 3], np.int32)), capacity=4)
+    doubled = bs.Map(
+        g, lambda k, v, c: (int(k), v + v), mode="host",
+        out=[np.int32, bs.ColType(np.int32, shape=(4,))],
+    )
+    rows = slicetest.scan_all(doubled)
+    # elementwise doubling, NOT list concatenation
+    assert list(rows[0][1]) == [4, 6, 0, 0]
+
+
+def test_stale_cache_format_is_miss(tmp_path):
+    import bigslice_tpu as bs
+    from bigslice_tpu import slicetest
+    from bigslice_tpu.ops.cache import shard_path
+
+    prefix = str(tmp_path / "c")
+    # Simulate an old-format cache file.
+    for s in range(2):
+        with open(shard_path(prefix, s, 2), "wb") as fp:
+            fp.write(b"BSF2" + b"\x00" * 16)
+    ran = []
+
+    def gen(shard):
+        ran.append(shard)
+        yield ([shard],)
+
+    rows = slicetest.sorted_rows(
+        bs.Cache(bs.ReaderFunc(2, gen, out=[np.int32]), prefix)
+    )
+    assert rows == [(0,), (1,)]
+    assert ran  # stale files recomputed, not crashed on
