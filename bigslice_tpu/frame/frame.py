@@ -274,12 +274,10 @@ class Frame:
         host = self.to_host()
         pycols = []
         for c in host.cols:
-            if c.dtype == object:
-                pycols.append(list(c))
-            elif c.ndim > 1:
-                # Vector columns: per-row ndarray cells (a nested list
-                # would make host-fn arithmetic like `v + v` concatenate
-                # instead of adding elementwise).
+            # Object columns and vector columns (ndim>1) keep per-row
+            # cells as-is — a nested list would make host-fn arithmetic
+            # like `v + v` concatenate instead of adding elementwise.
+            if c.dtype == object or c.ndim > 1:
                 pycols.append(list(c))
             else:
                 pycols.append(c.tolist())

@@ -45,10 +45,13 @@ class ShardCache:
         """A cached shard counts only if it exists AND carries the
         current codec format — files from older formats are cache
         misses (recompute + overwrite), not runtime crashes. Mid-file
-        corruption still fails loud at read time (checksums)."""
+        corruption still fails loud at read time (checksums). A 0-byte
+        file is a legitimately empty shard (its reader yielded no
+        frames), not a format mismatch."""
         try:
             with open(path, "rb") as fp:
-                return fp.read(4) == codec.MAGIC
+                head = fp.read(4)
+                return head == b"" or head == codec.MAGIC
         except OSError:
             return False
 

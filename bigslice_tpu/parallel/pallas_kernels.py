@@ -35,7 +35,7 @@ def _interpret() -> bool:
 
 @functools.lru_cache(maxsize=64)
 def _build_hash_partition(nparts: int, block_rows: int, seed32: int,
-                          interpret: bool):
+                          interpret: bool, with_counts: bool = True):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -43,7 +43,7 @@ def _build_hash_partition(nparts: int, block_rows: int, seed32: int,
     # Histogram lanes: one partition per lane, padded to a lane multiple.
     hist_lanes = ((nparts + LANES - 1) // LANES) * LANES
 
-    def kernel(keys_ref, ids_ref, counts_ref):
+    def kernel(keys_ref, ids_ref, counts_ref=None):
         step = pl.program_id(0)
 
         # murmur3 finalizer (matches frame/ops.py fmix32 bit-for-bit).
@@ -56,51 +56,58 @@ def _build_hash_partition(nparts: int, block_rows: int, seed32: int,
         ids = (x % jnp.uint32(nparts)).astype(jnp.int32)
         ids_ref[:] = ids
 
-        # Per-block histogram: compare against a lane iota and reduce
-        # over the block's rows/lanes.
-        pid = jax.lax.broadcasted_iota(
-            jnp.int32, (1, hist_lanes), dimension=1
-        )
-        onehot = (ids.reshape(-1, 1) == pid.reshape(1, -1)).astype(
-            jnp.int32
-        )
-        local = jnp.sum(onehot, axis=0, keepdims=True)
+        if counts_ref is not None:
+            # Per-block histogram: compare against a lane iota and
+            # reduce over the block's rows/lanes.
+            pid = jax.lax.broadcasted_iota(
+                jnp.int32, (1, hist_lanes), dimension=1
+            )
+            onehot = (ids.reshape(-1, 1) == pid.reshape(1, -1)).astype(
+                jnp.int32
+            )
+            local = jnp.sum(onehot, axis=0, keepdims=True)
 
-        @pl.when(step == 0)
-        def _init():
-            counts_ref[:] = jnp.zeros_like(counts_ref)
+            @pl.when(step == 0)
+            def _init():
+                counts_ref[:] = jnp.zeros_like(counts_ref)
 
-        counts_ref[:] += local
+            counts_ref[:] += local
 
     def run(keys2d):
         rows = keys2d.shape[0]
         grid = (rows // block_rows,)
-        return pl.pallas_call(
+        out_specs = [pl.BlockSpec((block_rows, LANES), lambda i: (i, 0))]
+        out_shape = [jax.ShapeDtypeStruct((rows, LANES), np.int32)]
+        if with_counts:
+            # Same accumulator block revisited every step.
+            out_specs.append(pl.BlockSpec((1, hist_lanes),
+                                          lambda i: (0, 0)))
+            out_shape.append(
+                jax.ShapeDtypeStruct((1, hist_lanes), np.int32)
+            )
+        out = pl.pallas_call(
             kernel,
             grid=grid,
             in_specs=[
                 pl.BlockSpec((block_rows, LANES), lambda i: (i, 0)),
             ],
-            out_specs=[
-                pl.BlockSpec((block_rows, LANES), lambda i: (i, 0)),
-                # Same accumulator block revisited every step.
-                pl.BlockSpec((1, hist_lanes), lambda i: (0, 0)),
-            ],
-            out_shape=[
-                jax.ShapeDtypeStruct((rows, LANES), np.int32),
-                jax.ShapeDtypeStruct((1, hist_lanes), np.int32),
-            ],
+            out_specs=out_specs,
+            out_shape=out_shape,
             interpret=interpret,
         )(keys2d)
+        return out if with_counts else (out[0], None)
 
     return jax.jit(run)
 
 
 def hash_partition(keys, nparts: int, seed: int = 0,
-                   block_rows: int = 8) -> Tuple:
-    """Fused hash+partition+histogram over an int32 key column.
+                   block_rows: int = 8,
+                   with_counts: bool = True) -> Tuple:
+    """Fused hash+partition(+histogram) over an int32 key column.
 
-    Returns (ids int32[n], counts int32[nparts]). Bit-identical to
+    Returns (ids int32[n], counts int32[nparts]) — ``counts`` is None
+    with ``with_counts=False`` (hash-only variant for callers that
+    re-count post-sort, e.g. the shuffle). Bit-identical to
     ``frame_ops.hash_device_column(keys, seed) % nparts`` + bincount.
     Rows are padded to a (block_rows, 128) grid; padding rows are
     excluded from the histogram by the caller-visible contract (we
@@ -116,7 +123,7 @@ def hash_partition(keys, nparts: int, seed: int = 0,
         # grid=(0,) would skip the accumulator init entirely, returning
         # uninitialized counts on real hardware.
         return (jnp.zeros((0,), jnp.int32),
-                jnp.zeros((nparts,), jnp.int32))
+                jnp.zeros((nparts,), jnp.int32) if with_counts else None)
     per_block = block_rows * LANES
     padded = ((n + per_block - 1) // per_block) * per_block
     npad = padded - n
@@ -125,10 +132,13 @@ def hash_partition(keys, nparts: int, seed: int = 0,
     )
     keys2d = flat.reshape(-1, LANES)
     fn = _build_hash_partition(
-        nparts, block_rows, int(frame_ops._seed32(seed)), _interpret()
+        nparts, block_rows, int(frame_ops._seed32(seed)), _interpret(),
+        with_counts,
     )
     ids2d, counts = fn(keys2d)
     ids = ids2d.reshape(-1)[:n]
+    if not with_counts:
+        return ids, None
     counts = counts.reshape(-1)[:nparts]
     if npad:
         # Padding zeros all hashed into one known bucket; remove them.

@@ -40,7 +40,8 @@ def send_capacity(capacity: int, nshards: int, slack: float = 2.0) -> int:
 def make_shuffle_fn(nshards: int, nkeys: int, capacity: int,
                     axis: str = "shards", seed: int = 0,
                     partition_fn: Optional[Callable] = None,
-                    slack: float = 2.0):
+                    slack: float = 2.0,
+                    use_pallas: Optional[bool] = None):
     """Build the per-device shuffle body (to be wrapped in shard_map).
 
     Operates on ``cols`` (each shape [capacity]) plus a valid-row count
@@ -76,11 +77,28 @@ def make_shuffle_fn(nshards: int, nkeys: int, capacity: int,
             part = jnp.where(bad, np.int32(nshards), part)
         else:
             bad = None
-            h = None
-            for k in keys:
-                kh = frame_ops.hash_device_column(k, seed)
-                h = kh if h is None else frame_ops.combine_hashes(h, kh)
-            part = (h % np.uint32(nshards)).astype(np.int32)
+            enable_pallas = use_pallas
+            if enable_pallas is None:
+                import jax
+
+                # Mosaic-compiled on TPU; on CPU the interpreter is
+                # slower than the fused XLA ops, so default off.
+                enable_pallas = jax.default_backend() == "tpu"
+            if (enable_pallas and nkeys == 1
+                    and np.dtype(keys[0].dtype) == np.dtype(np.int32)):
+                # Native tier: fused murmur hash + partition ids
+                # (parallel/pallas_kernels.py), bit-identical to the
+                # XLA path below.
+                from bigslice_tpu.parallel import pallas_kernels as pk
+
+                part, _ = pk.hash_partition(keys[0], nshards, seed,
+                                            with_counts=False)
+            else:
+                h = None
+                for k in keys:
+                    kh = frame_ops.hash_device_column(k, seed)
+                    h = kh if h is None else frame_ops.combine_hashes(h, kh)
+                part = (h % np.uint32(nshards)).astype(np.int32)
         # Invalid rows route to a virtual shard that sorts last.
         part = jnp.where(valid, part, np.int32(nshards))
         n_bad = (

@@ -235,3 +235,31 @@ def test_microbench_tool(capsys):
     microbench.bench_device_reduce(1 << 10)
     out = capsys.readouterr().out
     assert "eval_chain" in out and "device_reduce" in out
+
+
+def test_empty_cached_shard_stays_cached(tmp_path):
+    """A shard whose reader yields no frames caches as a 0-byte file —
+    which must count as cached (empty), not as a format mismatch."""
+    prefix = str(tmp_path / "c")
+    runs = []
+
+    def gen(shard):
+        runs.append(shard)
+        if shard == 0:
+            yield ([1, 2],)
+        # shard 1 legitimately yields nothing
+
+    import bigslice_tpu as bs
+
+    r1 = slicetest.sorted_rows(
+        bs.Cache(bs.ReaderFunc(2, gen, out=[np.int32]), prefix)
+    )
+    n = len(runs)
+    r2 = slicetest.sorted_rows(
+        bs.Cache(bs.ReaderFunc(2, gen, out=[np.int32]), prefix)
+    )
+    assert r1 == r2 == [(1,), (2,)]
+    assert len(runs) == n  # second run fully cached
+    # ReadCache accepts the cache too.
+    rows = slicetest.sorted_rows(bs.ReadCache([np.int32], 2, prefix))
+    assert rows == [(1,), (2,)]

@@ -184,3 +184,44 @@ def test_empty_shards(mesh):
     _, _, out_counts, overflow = red([cols[0]], [cols[1]], counts)
     assert int(np.asarray(out_counts).sum()) == 0
     assert int(overflow) == 0
+
+
+def test_mesh_shuffle_pallas_hash_path(mesh):
+    """The Pallas hash path (interpret mode here, Mosaic on TPU) routes
+    identically to the XLA hash path."""
+    rng = np.random.RandomState(3)
+    n = mesh.devices.size
+    cap = 128
+    per = 64
+    kc = [rng.randint(-1000, 1000, per).astype(np.int32)
+          for _ in range(n)]
+    vc = [np.arange(per, dtype=np.int32) for _ in range(n)]
+    cols, counts = shuffle_mod.shard_columns(mesh, [kc, vc], [per] * n, cap)
+
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from bigslice_tpu.parallel.meshutil import get_shard_map
+
+    outs = {}
+    for use_pallas in (False, True):
+        body = shuffle_mod.make_shuffle_fn(
+            n, 1, cap, "shards", use_pallas=use_pallas
+        )
+
+        def stepped(cnt, k, v):
+            c, ov, out = body(cnt[0], k, v)
+            return c.reshape(1), tuple(out)
+
+        f = jax.jit(get_shard_map()(
+            stepped, mesh=mesh,
+            in_specs=(P("shards"), P("shards"), P("shards")),
+            out_specs=(P("shards"), (P("shards"), P("shards"))),
+            check_rep=False,
+        ))
+        oc, (ok, ov) = f(counts, cols[0], cols[1])
+        outs[use_pallas] = (np.asarray(oc), np.asarray(ok),
+                            np.asarray(ov))
+    np.testing.assert_array_equal(outs[False][0], outs[True][0])
+    np.testing.assert_array_equal(outs[False][1], outs[True][1])
+    np.testing.assert_array_equal(outs[False][2], outs[True][2])
