@@ -405,14 +405,29 @@ class Head(_Pipelined):
 class Scan(_Pipelined):
     """Terminal per-shard sink (mirrors bigslice.Scan, slice.go:1005):
     ``fn(shard, reader)`` consumes the shard's stream; the resulting slice
-    is empty."""
+    is empty.
 
-    def __init__(self, slice_: Slice, fn: Callable):
+    By default any stream remainder the sink did not consume is drained
+    afterwards, so upstream side effects (WriterFunc taps, metrics)
+    always observe the full shard even for sinks that return early — a
+    deliberate divergence from the reference, which leaves unread
+    remainders unread (slice.go:1022-1028). Pass ``drain=False`` for
+    early-exit sinks over expensive sources: skipping the drain avoids
+    computing the discarded remainder, and also means a sink's external
+    side effects can't be retried due to a post-success upstream loss
+    surfacing mid-drain."""
+
+    def __init__(self, slice_: Slice, fn: Callable, drain: bool = True):
         super().__init__(slice_, slice_.schema, make_name("scan"))
         self.fn = fn
+        self.drain = drain
 
     def reader(self, shard, deps):
-        self.fn(shard, deps[0]())
+        r = deps[0]()
+        self.fn(shard, r)
+        if self.drain:
+            for _ in r:  # drain the remainder
+                pass
         return sliceio.empty_reader()
 
 

@@ -545,3 +545,30 @@ def test_groupbykey_feeds_traceable_map(sess):
 def test_groupbykey_rejects_host_columns():
     with pytest.raises(typecheck.TypecheckError):
         bs.GroupByKey(bs.Const(2, ["a", "b"], [1, 2]), capacity=4)
+
+
+def test_scan_drains_for_upstream_side_effects(sess):
+    """A sink that returns without consuming must not silently skip
+    upstream WriterFunc side effects (the stream is drained)."""
+    seen = []
+    w = bs.WriterFunc(
+        bs.Const(3, np.arange(30, dtype=np.int32)),
+        lambda shard, frame: seen.extend(frame.rows()),
+    )
+    res = slicetest.run(bs.Scan(w, lambda shard, reader: None),
+                        session=sess)
+    assert res.rows() == []
+    assert len(seen) == 30
+
+
+def test_scan_drain_opt_out(sess):
+    """drain=False restores early-exit semantics: upstream taps see only
+    what the sink consumed."""
+    seen = []
+    w = bs.WriterFunc(
+        bs.Const(1, np.arange(10, dtype=np.int32)),
+        lambda shard, frame: seen.append(len(frame)),
+    )
+    slicetest.run(bs.Scan(w, lambda shard, reader: None, drain=False),
+                  session=sess)
+    assert seen == []  # nothing consumed, nothing computed
