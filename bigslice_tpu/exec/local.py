@@ -31,6 +31,12 @@ from bigslice_tpu.utils import metrics as metrics_mod
 # exec/combiner.go:227-305 — on-device re-combining replaces disk spill).
 COMBINE_FLUSH_ROWS = 1 << 20
 
+# Rows per non-combined partition buffer before spilling to disk (the
+# reference's task-buffer/store spill role for pure shuffles,
+# sliceio/spiller.go): combiner-less partitions can't collapse in place,
+# so beyond-memory shuffles stream through codec-encoded spill files.
+SHUFFLE_SPILL_ROWS = 1 << 21
+
 
 class DepLost(Exception):
     """A dependency's stored output is gone; carries the producer task(s)
@@ -191,15 +197,29 @@ class LocalExecutor:
             self._limiter.release(permits)
 
     def _execute(self, task: Task) -> None:
+        spillers: List[Optional[object]] = []
+        try:
+            self._execute_inner(task, spillers)
+        finally:
+            # Spill dirs must never outlive the task (error paths
+            # included); cleanup is idempotent.
+            for sp in spillers:
+                if sp is not None:
+                    sp.cleanup()
+
+    def _execute_inner(self, task: Task, spillers) -> None:
         factories = [self._dep_factory(d) for d in task.deps]
         reader = task.do(factories)
         nparts = task.num_partition
         if nparts <= 1 and task.combiner is None:
-            self.store.put(task.name, 0, [f for f in reader if len(f)])
+            # Streamed: a streaming store (FileStore) writes batch by
+            # batch without materializing the shard.
+            self.store.put(task.name, 0, (f for f in reader if len(f)))
             return
         parts: List[List[Frame]] = [[] for _ in range(nparts)]
         pending_rows = [0] * nparts
         flush_at = [COMBINE_FLUSH_ROWS] * nparts
+        spillers.extend([None] * nparts)
         for frame in reader:
             if not len(frame):
                 continue
@@ -220,6 +240,22 @@ class LocalExecutor:
                         pending_rows[p] = len(combined)
                         flush_at[p] = max(COMBINE_FLUSH_ROWS,
                                           2 * len(combined))
+                    elif (task.combiner is None
+                            and self.store.streaming
+                            and pending_rows[p] >= SHUFFLE_SPILL_ROWS):
+                        # Pure shuffle over a streaming store: spill the
+                        # partition buffer and stream it back at store
+                        # time, keeping the working set bounded. (With
+                        # the in-memory store a disk round-trip buys
+                        # nothing — contents end up resident either
+                        # way.)
+                        from bigslice_tpu import sortio
+
+                        if spillers[p] is None:
+                            spillers[p] = sortio.Spiller()
+                        spillers[p].spill(iter(parts[p]))
+                        parts[p] = []
+                        pending_rows[p] = 0
         comb = task.combiner
         ck = task.partitioner.combine_key
         if comb is not None and ck:
@@ -229,6 +265,21 @@ class LocalExecutor:
             if comb is not None:
                 out = comb.combine_frames(parts[p])
                 frames = [out] if len(out) else []
+            elif spillers[p] is not None:
+                # Stream spilled runs + the in-memory tail into the
+                # store (FileStore writes incrementally, so the working
+                # set stays bounded; MemoryStore materializes by
+                # nature). Spill files are removed once consumed.
+                sp, tail = spillers[p], parts[p]
+
+                def rehydrate(sp=sp, tail=tail):
+                    for r in sp.readers():
+                        yield from r
+                    yield from tail
+
+                self.store.put(task.name, p, rehydrate())
+                sp.cleanup()
+                continue
             else:
                 frames = parts[p]
             self.store.put(task.name, p, frames)

@@ -28,7 +28,15 @@ class Missing(KeyError):
 
 
 class Store:
-    def put(self, name: TaskName, partition: int, frames: List[Frame]) -> None:
+    # True when put() writes incrementally (bounded memory for streamed
+    # inputs); False when contents are held in memory anyway.
+    streaming = False
+
+    def put(self, name: TaskName, partition: int, frames) -> None:
+        """Store a partition's frames. ``frames`` is any iterable and is
+        consumed eagerly, in full, before put returns (callers may hand
+        in generators over transient resources, e.g. spill files they
+        delete right after)."""
         raise NotImplementedError
 
     def committed(self, name: TaskName, partition: int) -> bool:
@@ -47,8 +55,11 @@ class MemoryStore(Store):
         self._data: Dict[Tuple[TaskName, int], List[Frame]] = {}
 
     def put(self, name, partition, frames):
+        # Consume OUTSIDE the lock: callers may hand in lazy streams
+        # whose production reads other partitions from this same store.
+        frames = list(frames)
         with self._lock:
-            self._data[(name, partition)] = list(frames)
+            self._data[(name, partition)] = frames
 
     def committed(self, name, partition):
         with self._lock:
@@ -68,6 +79,8 @@ class MemoryStore(Store):
 
 
 class FileStore(Store):
+    streaming = True
+
     def __init__(self, prefix: str):
         self.prefix = prefix
 

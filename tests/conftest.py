@@ -24,3 +24,26 @@ if "xla_force_host_platform_device_count" not in _flags:
 from bigslice_tpu.utils.hermetic import force_hermetic_cpu
 
 force_hermetic_cpu()
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(params=["local", "mesh"])
+def sess(request):
+    """Executor-parameterized sessions (the slice_test.go:64-66 pattern):
+    tests taking this fixture run on the local executor AND the mesh
+    executor (device-eligible op groups go SPMD; the rest exercise the
+    fallback interop)."""
+    from bigslice_tpu.exec.session import Session
+
+    if request.param == "local":
+        return Session()
+    import numpy as np
+    import jax
+    from jax.sharding import Mesh
+
+    from bigslice_tpu.exec.meshexec import MeshExecutor
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("shards",))
+    return Session(executor=MeshExecutor(mesh))

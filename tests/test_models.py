@@ -14,6 +14,14 @@ import bigslice_tpu.models.maxint as maxint
 import bigslice_tpu.models.wordcount as wc_mod
 
 
+def test_wordcount_ids_both_executors(sess):
+    rng = np.random.RandomState(5)
+    ids = rng.randint(0, 64, 8 * 300).astype(np.int32)
+    got = dict(sess.run(wc_mod.wordcount_ids(8, ids, 64)).rows())
+    oracle = dict(zip(*np.unique(ids, return_counts=True)))
+    assert got == {int(k): int(v) for k, v in oracle.items()}
+
+
 def test_int_max_random_vs_oracle():
     # Property-style check mirroring example/max_test.go's quick.Check.
     rng = np.random.RandomState(0)

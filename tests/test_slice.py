@@ -15,23 +15,6 @@ from bigslice_tpu import slicetest, typecheck
 from bigslice_tpu.exec.session import Session
 
 
-@pytest.fixture(params=["local", "mesh"])
-def sess(request):
-    """Executor-parameterized sessions (the slice_test.go:64-66 pattern):
-    every combinator test runs on the local executor AND the mesh
-    executor (device-eligible groups go SPMD; the rest exercise the
-    fallback interop)."""
-    if request.param == "local":
-        return Session()
-    import jax
-    from jax.sharding import Mesh
-
-    from bigslice_tpu.exec.meshexec import MeshExecutor
-
-    mesh = Mesh(np.array(jax.devices()[:8]), ("shards",))
-    return Session(executor=MeshExecutor(mesh))
-
-
 def test_const_roundtrip(sess):
     s = bs.Const(3, [1, 2, 3, 4, 5, 6, 7], ["a", "b", "c", "d", "e", "f", "g"])
     rows = slicetest.sorted_rows(s, session=sess)
@@ -572,3 +555,30 @@ def test_scan_drain_opt_out(sess):
     slicetest.run(bs.Scan(w, lambda shard, reader: None, drain=False),
                   session=sess)
     assert seen == []  # nothing consumed, nothing computed
+
+
+def test_shuffle_partition_spill(monkeypatch, tmp_path_factory):
+    """Combiner-less shuffle partitions beyond the spill threshold stream
+    through disk and reassemble exactly."""
+    import bigslice_tpu.exec.local as local_mod
+    from bigslice_tpu import sortio
+
+    monkeypatch.setattr(local_mod, "SHUFFLE_SPILL_ROWS", 256)
+    spills = []
+    orig = sortio.Spiller.spill
+
+    def counting(self, frames):
+        spills.append(1)
+        return orig(self, frames)
+
+    monkeypatch.setattr(sortio.Spiller, "spill", counting)
+    from bigslice_tpu.exec.local import LocalExecutor
+    from bigslice_tpu.exec.store import FileStore
+
+    store = FileStore(str(tmp_path_factory.mktemp("spillstore")))
+    keys = np.arange(5000, dtype=np.int32)
+    r = bs.Reshuffle(bs.Const(2, keys))
+    rows = sorted(Session(executor=LocalExecutor(store=store)).run(r)
+                  .rows())
+    assert rows == [(i,) for i in range(5000)]
+    assert spills  # the disk path actually engaged (streaming store)
