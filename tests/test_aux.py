@@ -263,3 +263,28 @@ def test_empty_cached_shard_stays_cached(tmp_path):
     # ReadCache accepts the cache too.
     rows = slicetest.sorted_rows(bs.ReadCache([np.int32], 2, prefix))
     assert rows == [(1,), (2,)]
+
+
+def test_rebatch():
+    from bigslice_tpu import sliceio
+    from bigslice_tpu.frame.frame import Frame
+
+    frames = [Frame([np.arange(i * 10, i * 10 + 7, dtype=np.int32)])
+              for i in range(5)]  # 5 ragged 7-row frames
+    out = list(sliceio.rebatch(iter(frames), 10))
+    assert [len(f) for f in out] == [10, 10, 10, 5]
+    flat = [v for f in out for (v,) in f.rows()]
+    assert flat == [v for f in frames for (v,) in f.rows()]
+
+
+def test_sliceconfig_auto_selects_mesh(monkeypatch, tmp_path):
+    # With >1 visible device, executor "auto" builds a MeshExecutor.
+    from bigslice_tpu import sliceconfig
+    from bigslice_tpu.exec.meshexec import MeshExecutor
+
+    monkeypatch.setattr(sliceconfig, "CONFIG_PATH",
+                        str(tmp_path / "none"))
+    sess, rest = sliceconfig.parse([])
+    assert rest == []
+    assert isinstance(sess.executor, MeshExecutor)
+    assert sess.executor.nmesh == 8
