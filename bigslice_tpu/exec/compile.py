@@ -244,14 +244,44 @@ def _frame_combiner(consumer: Slice):
     return FrameCombiner(comb.fn, consumer.deps()[0].slice.schema)
 
 
+def _is_jax_stage(s: Slice) -> bool:
+    from bigslice_tpu.ops.mapops import Filter, Flatmap, Map
+
+    return (isinstance(s, (Map, Filter, Flatmap))
+            and getattr(s, "mode", "") == "jax")
+
+
 def _make_do(chain: Sequence[Slice], shard: int):
     """Compose the chain's readers into one task body
     (exec/compile.go:338-385). Re-entrant: each call builds fresh
-    readers, so lost-task reruns are safe."""
+    readers, so lost-task reruns are safe.
+
+    At the first jax-mode stage (scanning from the innermost), the input
+    stream is re-chunked to large device batches — the host→device
+    boundary re-batch, applied once per fused chain. Chains containing a
+    Head are bounded consumers and skip it (prefetching 16× the limit
+    would defeat early exit)."""
+    from bigslice_tpu.ops.mapops import Head
+
+    stages = list(reversed(chain))  # innermost first
+    bounded = any(isinstance(s, Head) for s in chain)
+
+    def boundary(r):
+        return sliceio.rebatch(r, sliceio.DEVICE_BATCH_ROWS)
 
     def do(dep_factories):
-        reader = chain[-1].reader(shard, dep_factories)
-        for s in reversed(chain[:-1]):
+        inserted = bounded
+        if not inserted and _is_jax_stage(stages[0]) and dep_factories:
+            base = dep_factories[0]
+            dep_factories = [lambda b=base: boundary(b())] + list(
+                dep_factories[1:]
+            )
+            inserted = True
+        reader = stages[0].reader(shard, dep_factories)
+        for s in stages[1:]:
+            if not inserted and _is_jax_stage(s):
+                reader = boundary(reader)
+                inserted = True
             r_prev = reader
             reader = s.reader(shard, [lambda r=r_prev: r])
         return reader

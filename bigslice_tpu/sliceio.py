@@ -24,8 +24,14 @@ ReaderFactory = Callable[[], Reader]
 
 # Default batch size for host-tier sources, mirroring
 # internal/defaultsize.Chunk (internal/defaultsize/size.go:14-19). Device
-# pipelines want far larger batches; executors re-batch at the boundary.
+# pipelines want far larger batches: the compiler inserts ``rebatch``
+# once per fused chain at the first jax-mode stage (bounded chains with
+# Head skip it — see exec/compile._make_do).
 DEFAULT_CHUNK_ROWS = 4096
+
+# Target rows per batch entering jitted device stages: large enough to
+# amortize dispatch and fill the VPU/MXU, small enough for HBM headroom.
+DEVICE_BATCH_ROWS = 1 << 16
 
 
 def empty_reader() -> Reader:

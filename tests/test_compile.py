@@ -178,3 +178,30 @@ def test_result_reuse_adapters_distinct_names():
     assert [(k, len(a), len(b)) for k, a, b in rows] == [
         (1, 1, 16), (2, 1, 16)
     ]
+
+
+def test_device_boundary_rebatch_once_per_chain():
+    """The compiler re-chunks host batches before the first jax stage;
+    Head chains skip it so early exit stays lazy."""
+    import bigslice_tpu.slicetest as slicetest
+    from bigslice_tpu import sliceio
+
+    pulls = []
+
+    def gen(shard):
+        for i in range(1000):
+            pulls.append(i)
+            yield ([i],)  # 1000 one-row host batches
+
+    # Unbounded chain: rebatch coalesces the tiny batches.
+    src = bs.ReaderFunc(1, gen, out=[np.int32])
+    rows = slicetest.scan_all(bs.Map(src, lambda x: x + 1))
+    assert sorted(rows) == [(i + 1,) for i in range(1000)]
+    assert len(pulls) == 1000
+
+    # Bounded chain (Head): the source must NOT be drained 64k-deep.
+    pulls.clear()
+    src2 = bs.ReaderFunc(1, gen, out=[np.int32])
+    h = bs.Head(bs.Map(src2, lambda x: x + 1), 5)
+    assert len(slicetest.scan_all(h)) == 5
+    assert len(pulls) < 100  # early exit preserved
