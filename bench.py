@@ -80,7 +80,11 @@ def device_bench(keys: np.ndarray, vals: np.ndarray, iters: int = 5):
 
 def join_bench(n_rows: int, iters: int = 3):
     """rows/sec for the device join (reduce both sides + align): the
-    BASELINE Reduce+Cogroup headline shape."""
+    BASELINE Reduce+Cogroup headline shape.
+
+    Note: the CPU baseline (np.unique per side) is a much lighter
+    operation than the full two-sided shuffle+align — the vs_baseline
+    ratio is only meaningful on TPU hardware."""
     import jax
     from jax.sharding import Mesh
 

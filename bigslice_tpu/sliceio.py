@@ -90,7 +90,8 @@ def merge_reader(readers: Sequence[Reader], schema: Schema) -> Reader:
     sortio.NewMergeReader, sortio/sort.go:154-216).
 
     Host-tier merge used when combining spilled/sorted partition streams;
-    the device-tier equivalent is a sharded lax.sort (parallel/sortops.py).
+    the device-tier equivalent is the sort in parallel/segment.py's
+    kernels.
     """
     # Buffered cursor per reader: (frames exhausted lazily, row index).
     cursors = []
