@@ -199,3 +199,31 @@ def test_ordered_dispatch_mode(mesh):
     # (dispatcher thread persists).
     got2 = dict(sess.run(build()).rows())
     assert got2 == base
+
+
+def test_concurrent_result_scans_on_mesh(mesh):
+    """Concurrent scans of a discarded mesh Result force simultaneous
+    re-evaluations of shared tasks through the group/claim machinery."""
+    import threading
+
+    sess = Session(executor=MeshExecutor(mesh))
+    base = sess.run(bs.Map(bs.Const(8, np.arange(80, dtype=np.int32)),
+                           lambda x: x * 3))
+    expect = sorted((3 * i,) for i in range(80))
+    errs = []
+
+    for round_ in range(3):
+        base.discard()
+
+        def scan():
+            try:
+                assert sorted(base.rows()) == expect
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        threads = [threading.Thread(target=scan) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errs, errs
