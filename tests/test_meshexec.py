@@ -201,12 +201,11 @@ def test_ordered_dispatch_mode(mesh):
     assert got2 == base
 
 
-def test_concurrent_result_scans_on_mesh(mesh):
+def test_concurrent_result_scans_on_mesh(sess):
     """Concurrent scans of a discarded mesh Result force simultaneous
     re-evaluations of shared tasks through the group/claim machinery."""
     import threading
 
-    sess = Session(executor=MeshExecutor(mesh))
     base = sess.run(bs.Map(bs.Const(8, np.arange(80, dtype=np.int32)),
                            lambda x: x * 3))
     expect = sorted((3 * i,) for i in range(80))
@@ -217,13 +216,17 @@ def test_concurrent_result_scans_on_mesh(mesh):
 
         def scan():
             try:
-                assert sorted(base.rows()) == expect
+                assert rows_sorted(base) == expect
             except Exception as e:  # pragma: no cover
                 errs.append(e)
 
-        threads = [threading.Thread(target=scan) for _ in range(4)]
+        threads = [threading.Thread(target=scan, daemon=True)
+                   for _ in range(4)]
         for t in threads:
             t.start()
         for t in threads:
             t.join(timeout=60)
+        # A silent join timeout would mask the very deadlock this test
+        # exists to catch.
+        assert not any(t.is_alive() for t in threads), "scan deadlocked"
         assert not errs, errs
