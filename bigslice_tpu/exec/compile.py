@@ -111,7 +111,7 @@ class Compiler:
         # Compile dependencies. A shuffle dep's producer tasks partition
         # their output into num_tasks partitions and take the consumer's
         # combiner (map-side combining).
-        dep_task_lists: List[Tuple[List[Task], bool]] = []
+        dep_task_lists: List[Tuple[List[Task], object, Partitioner]] = []
         for dep in innermost.deps():
             if dep.shuffle:
                 comb = _frame_combiner(innermost)
@@ -136,7 +136,11 @@ class Compiler:
                 # carries everything.
                 dep_part = Partitioner(num_partition=1)
             dep_tasks = self.compile(dep.slice, dep_part)
-            dep_task_lists.append((dep_tasks, dep))
+            # Record the per-dep partitioner: TaskDep construction below
+            # must use THIS dep's combine key, not the last loop
+            # iteration's (a multi-dep consumer with combiners would
+            # otherwise attach the wrong dep's key).
+            dep_task_lists.append((dep_tasks, dep, dep_part))
 
         op_name = "_".join(s.name.op for s in reversed(chain))
         loc = chain[0].name
@@ -160,7 +164,7 @@ class Compiler:
         tasks: List[Task] = []
         for shard in range(num_tasks):
             deps = []
-            for dep_tasks, dep in dep_task_lists:
+            for dep_tasks, dep, dep_part in dep_task_lists:
                 if dep.shuffle:
                     deps.append(
                         TaskDep(

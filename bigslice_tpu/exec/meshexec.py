@@ -48,6 +48,11 @@ from bigslice_tpu.parallel import shuffle as shuffle_mod
 # the fallback executor rather than waiting forever.
 GROUP_WAIT_SECS = 0.25
 
+# Compiled SPMD programs kept per executor (FIFO-evicted): iterative
+# drivers that rebuild chains each round must not grow the cache (and its
+# compiled executables) without bound.
+_PROGRAM_CACHE_MAX = 64
+
 
 class DeviceGroupOutput:
     """A group's output resident on the mesh: row-sharded global columns
@@ -130,6 +135,7 @@ class MeshExecutor:
         # prior run), are cancelled/skipped from the plan.
         self.ordered_dispatch = ordered_dispatch
         self._plan: List[Tuple] = []
+        self._plan_set: set = set()  # mirrors _plan membership
         self._ready_set: set = set()
         self._cancelled: set = set()
         self._ready_cond = threading.Condition(self._lock)
@@ -148,11 +154,10 @@ class MeshExecutor:
         if not self.ordered_dispatch:
             return
         with self._lock:
-            seen = set(self._plan)
             for k in keys:
-                if k is not None and k not in seen:
+                if k is not None and k not in self._plan_set:
                     self._plan.append(k)
-                    seen.add(k)
+                    self._plan_set.add(k)
             if self._dispatcher is None:
                 self._dispatcher = threading.Thread(
                     target=self._dispatch_loop, daemon=True
@@ -172,6 +177,7 @@ class MeshExecutor:
             return
         key = task.group_key
         complete = False
+        planned = False
         with self._lock:
             g = self._groups.get(key)
             if g is None:
@@ -182,21 +188,33 @@ class MeshExecutor:
                 g.launched = True
                 if g.timer:
                     g.timer.cancel()
+                if self.ordered_dispatch:
+                    # A group whose key is no longer (or never was) in
+                    # the plan would park in _ready_set forever — the
+                    # dispatcher only pops plan heads. This happens when
+                    # the plan head timed out (its deps ran slowly on the
+                    # fallback path) and was skipped before its tasks
+                    # were submitted: dispatch such groups directly
+                    # instead of deadlocking. Direct dispatch gives up
+                    # launch ordering for this group — safe in-process
+                    # (programs on one set of devices serialize), NOT a
+                    # cross-process ordering guarantee; the multi-host
+                    # session protocol replaces wall-clock skips
+                    # outright.
+                    planned = key in self._plan_set
+                    if planned:
+                        self._ready_set.add(key)
+                        self._ready_cond.notify_all()
             elif g.timer is None and not g.launched:
                 g.timer = threading.Timer(
                     GROUP_WAIT_SECS, self._flush_stragglers, (key,)
                 )
                 g.timer.daemon = True
                 g.timer.start()
-        if complete:
-            if self.ordered_dispatch:
-                with self._lock:
-                    self._ready_set.add(key)
-                    self._ready_cond.notify_all()
-            else:
-                threading.Thread(
-                    target=self._run_group, args=(key,), daemon=True
-                ).start()
+        if complete and not planned:
+            threading.Thread(
+                target=self._run_group, args=(key,), daemon=True
+            ).start()
 
     def device_group_count(self) -> int:
         """How many op groups have run on the device path (diagnostics;
@@ -278,10 +296,12 @@ class MeshExecutor:
                     head = self._plan[0]
                     if head in self._cancelled:
                         self._plan.pop(0)
+                        self._plan_set.discard(head)
                         self._cancelled.discard(head)
                         continue
                     if head in self._ready_set:
                         self._plan.pop(0)
+                        self._plan_set.discard(head)
                         self._ready_set.discard(head)
                         key = head
                         break
@@ -289,11 +309,15 @@ class MeshExecutor:
                     # tasks satisfied by a prior run): after a grace
                     # period with no sign of it, skip — such groups run
                     # no collectives on any process, so skipping is
-                    # cross-process consistent.
+                    # cross-process consistent. If its tasks show up
+                    # later anyway (slow fallback deps), submit() sees
+                    # the key gone from the plan and dispatches the
+                    # group directly rather than parking it.
                     if not self._ready_cond.wait(timeout=GROUP_WAIT_SECS):
                         if (head not in self._ready_set
                                 and head not in self._groups):
                             self._plan.pop(0)
+                            self._plan_set.discard(head)
                             self._cancelled.discard(head)
             try:
                 self._run_group(key)
@@ -471,9 +495,22 @@ class MeshExecutor:
         key = (tuple((k, sid) for k, sid, _ in stages), capacity,
                task.num_partition, len(task.schema),
                self._input_ncols(task), slack)
-        cached = self._programs.get(key)
-        if cached is not None:
-            return cached[0], stages
+        # The key embeds id()s of stage functions, which can recycle after
+        # GC; weakrefs to the actual function objects guard each entry
+        # (the jitutil._VMAP_CACHE pattern) — a recycled id recompiles
+        # instead of silently reusing a stale program. Today the cached
+        # program's closure pins the stage fns (the guard can't fire
+        # while an entry lives); it stays as insurance against refactors
+        # that weaken that pinning.
+        fns = self._stage_fns(stages)
+        with self._lock:
+            cached = self._programs.get(key)
+            if cached is not None:
+                prog, refs = cached
+                if len(refs) == len(fns) and all(
+                    r is None or r() is f for r, f in zip(refs, fns)
+                ):
+                    return prog, stages
 
         import jax
         import jax.numpy as jnp
@@ -572,8 +609,39 @@ class MeshExecutor:
             shard_map(stepped, mesh=self.mesh, in_specs=in_specs,
                       out_specs=out_specs, check_rep=False)
         )
-        self._programs[key] = (prog, stages)
+        import weakref
+
+        refs = []
+        for f in fns:
+            try:
+                refs.append(weakref.ref(f))
+            except TypeError:  # unweakrefable callables
+                refs.append(None)
+        # Concurrent _run_group threads insert/evict under the lock
+        # (pop-first is not atomic against another thread's pop).
+        with self._lock:
+            self._programs[key] = (prog, tuple(refs))
+            while len(self._programs) > _PROGRAM_CACHE_MAX:
+                self._programs.pop(next(iter(self._programs)))
         return prog, stages
+
+    @staticmethod
+    def _stage_fns(stages) -> list:
+        """The user function objects a compiled program closes over, in
+        stage order (cache-validation identities)."""
+        fns = []
+        for kind, _, s in stages:
+            if kind == "map":
+                fns.append(s.fn)
+            elif kind == "filter":
+                fns.append(s.pred)
+            elif kind == "combine":
+                fns.append(s.frame_combiner.fn)
+            elif kind == "shuffle":
+                fc = s.partitioner.combiner
+                if fc is not None:
+                    fns.append(fc.fn)
+        return fns
 
     def _input_ncols(self, task: Task) -> int:
         innermost = task.chain[-1]

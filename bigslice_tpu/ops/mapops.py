@@ -68,6 +68,43 @@ def _try_trace(fn: Callable, in_schema: Schema, extra: tuple = ()):
         return None
 
 
+_CAST_WRAPPERS: "dict" = {}
+_CAST_WRAPPERS_MAX = 128
+
+
+def _cast_wrapper(base_fn: Callable, dtypes: tuple) -> Callable:
+    """An output-casting wrapper around ``base_fn``, shared across
+    constructions (keyed like jitutil._VMAP_CACHE: id + weakref
+    aliveness guard, bounded FIFO)."""
+    import weakref
+
+    key = (id(base_fn), dtypes)
+    entry = _CAST_WRAPPERS.get(key)
+    if entry is not None:
+        ref, wrapper = entry
+        if ref is None or ref() is base_fn:
+            return wrapper
+
+    def wrapper(*args, _f=base_fn, _dts=dtypes):
+        import jax.numpy as jnp
+
+        o = _f(*args)
+        if not isinstance(o, (tuple, list)):
+            o = (o,)
+        return tuple(
+            jnp.asarray(v).astype(dt) for v, dt in zip(o, _dts)
+        )
+
+    try:
+        ref = weakref.ref(base_fn)
+    except TypeError:  # unweakrefable callables
+        ref = None
+    _CAST_WRAPPERS[key] = (ref, wrapper)
+    while len(_CAST_WRAPPERS) > _CAST_WRAPPERS_MAX:
+        _CAST_WRAPPERS.pop(next(iter(_CAST_WRAPPERS)))
+    return wrapper
+
+
 class _Pipelined(Slice):
     """Base for single-dep, non-shuffle (fusable) slices."""
 
@@ -132,18 +169,17 @@ class Map(_Pipelined):
                 if tuple(c.dtype for c in schema) != tuple(
                     c.dtype for c in traced
                 ):
-                    import jax.numpy as jnp
-
-                    base_fn, dtypes = fn, [c.dtype for c in schema]
-
-                    def fn(*args, _f=base_fn, _dts=tuple(dtypes)):
-                        o = _f(*args)
-                        if not isinstance(o, (tuple, list)):
-                            o = (o,)
-                        return tuple(
-                            jnp.asarray(v).astype(dt)
-                            for v, dt in zip(o, _dts)
-                        )
+                    # The cast wrapper IS the op's function from here on:
+                    # executors that trace self.fn directly (the mesh
+                    # path vmaps it inside the SPMD program) must see the
+                    # same dtypes the schema declares. Memoized per
+                    # (user fn, dtypes) so rebuilding the Map each round
+                    # of an iterative driver keeps a stable function
+                    # identity (jit/program caches key on id(fn)).
+                    fn = _cast_wrapper(
+                        fn, tuple(c.dtype for c in schema)
+                    )
+                    self.fn = fn
 
             self._vfn = get_padded_vmap(fn)
         else:

@@ -205,3 +205,46 @@ def test_device_boundary_rebatch_once_per_chain():
     h = bs.Head(bs.Map(src2, lambda x: x + 1), 5)
     assert len(slicetest.scan_all(h)) == 5
     assert len(pulls) < 100  # early exit preserved
+
+
+def test_multi_dep_combine_keys_attach_per_dep():
+    """A combiner-bearing consumer with several shuffle deps must attach
+    each dep's OWN machine-combine key to its TaskDep (round-1 advisor,
+    low: the last-compiled dep's key used to leak onto every dep)."""
+    from bigslice_tpu.ops.base import Combiner, Dep, Slice, make_name
+    from bigslice_tpu.exec.compile import Compiler
+    from bigslice_tpu.slicetype import ColType, Schema
+
+    schema = Schema([ColType(np.dtype(np.int32)),
+                     ColType(np.dtype(np.int32))], prefix=1)
+
+    def combine(a, b):
+        return a + b
+
+    class TwoDepCombining(Slice):
+        def __init__(self, a, b):
+            super().__init__(schema, a.num_shards, make_name("twodep"))
+            self.a, self.b = a, b
+
+        def deps(self):
+            return (Dep(self.a, shuffle=True),
+                    Dep(self.b, shuffle=True))
+
+        def combiner(self):
+            return Combiner(combine)
+
+        def reader(self, shard, deps):  # pragma: no cover - not executed
+            raise NotImplementedError
+
+    a = bs.Const(2, np.arange(8, dtype=np.int32),
+                 np.ones(8, dtype=np.int32))
+    b = bs.Const(2, np.arange(8, dtype=np.int32),
+                 np.ones(8, dtype=np.int32))
+    tasks = Compiler(1, machine_combiners=True).compile(
+        TwoDepCombining(a, b)
+    )
+    for t in tasks:
+        ka, kb = t.deps[0].combine_key, t.deps[1].combine_key
+        assert ka and kb and ka != kb
+        assert f"-{id(a)}-" in ka
+        assert f"-{id(b)}-" in kb

@@ -230,3 +230,75 @@ def test_concurrent_result_scans_on_mesh(sess):
         # exists to catch.
         assert not any(t.is_alive() for t in threads), "scan deadlocked"
         assert not errs, errs
+
+
+def test_ordered_dispatch_slow_host_deps_no_deadlock(mesh):
+    """Plan heads whose deps run slowly on the fallback path used to be
+    popped by the dispatch timeout and then parked in _ready_set forever
+    when their tasks finally arrived (round-1 advisor, high): the run
+    must complete and still use the device path for the reduce group."""
+    import threading
+    import time
+
+    sess = Session(executor=MeshExecutor(mesh, ordered_dispatch=True))
+
+    def slow_ident(k, v):
+        time.sleep(0.05)
+        return (k, v)
+
+    def build():
+        s = bs.Const(8, np.arange(64, dtype=np.int32) % 4,
+                     np.ones(64, dtype=np.int32))
+        m = bs.Map(s, slow_ident, out=[np.int32, np.int32], mode="host")
+        return bs.Reduce(m, lambda a, b: a + b)
+
+    out = {}
+
+    def run():
+        out["rows"] = dict(sess.run(build()).rows())
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    t.join(timeout=120)
+    assert not t.is_alive(), "ordered dispatch deadlocked"
+    assert out["rows"] == {0: 16, 1: 16, 2: 16, 3: 16}
+
+
+def test_map_out_dtype_cast_on_mesh(sess):
+    """Map with out= declaring a different dtype than the traced output
+    must yield the declared dtype on the mesh path too (round-1 advisor,
+    medium: the mesh program used to vmap the uncast fn)."""
+    s = bs.Const(8, np.arange(32, dtype=np.int32))
+    m = bs.Map(s, lambda x: x, out=[np.float32])
+    res = sess.run(m)
+    assert sess.executor.device_group_count() >= 1
+    for f in res.frames():
+        assert np.asarray(f.cols[0]).dtype == np.float32
+    assert rows_sorted(res) == [(float(i),) for i in range(32)]
+
+
+def test_program_cache_guards_recycled_fn_ids(mesh):
+    """A program-cache entry whose stage function has been GC'd (dead
+    weakref) must recompile rather than reuse the stale program keyed by
+    a recycled id (round-1 advisor, medium)."""
+    import weakref
+
+    from bigslice_tpu.exec import compile as compile_mod
+
+    ex = MeshExecutor(mesh)
+    Session(executor=ex)
+    s = bs.Map(bs.Const(8, np.arange(16, dtype=np.int32)),
+               lambda x: x + 1)
+    task = compile_mod.compile_slice(s)[0]
+    prog1, _ = ex._program(task, 8)
+    assert len(ex._programs) == 1
+    key = next(iter(ex._programs))
+
+    class _Tmp:
+        pass
+
+    dead = weakref.ref(_Tmp())  # dies immediately
+    assert dead() is None
+    ex._programs[key] = ("stale", (dead,))
+    prog2, _ = ex._program(task, 8)
+    assert prog2 != "stale"
