@@ -1,17 +1,35 @@
-"""Benchmark: keyed Reduce throughput on the device vs a CPU baseline.
-
-The BASELINE.md headline metric is rows/sec on a keyed Reduce (config #1/
-#2 shape: map-side combine → hash shuffle → final combine). The reference
-publishes no numbers (BASELINE.md), so the baseline column is measured
-here: a numpy sort+reduceat implementation — a *strong* single-core CPU
-stand-in for bigslice's local executor (which pays per-record reflection
-on top; numpy is deliberately generous to the baseline).
-
-The device path runs the full SPMD pipeline (MeshReduceByKey: on-device
-murmur hash, sort, segmented scan, all_to_all, final combine) on
-however many chips are visible — one program, collectives over ICI.
+"""Benchmark harness: the five BASELINE.md configs, kernel and end-to-end.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Modes (argv[1], default "reduce"):
+
+- ``reduce``      end-to-end keyed Reduce through Session+MeshExecutor —
+                  host rows in, result scan out (config #1/#2 shape).
+                  The honest framework number: includes host→device
+                  upload, compile-cache lookups, the evaluator, and
+                  result readback, not just the kernel.
+- ``reduce-kernel``  the raw MeshReduceByKey SPMD kernel on pre-staged
+                  device arrays (the round-1 metric; upper bound).
+- ``join``        end-to-end JoinAggregate through the Session (config
+                  #3, the BASELINE Reduce+Cogroup headline shape).
+- ``join-kernel`` raw MeshJoinAggregate kernel.
+- ``wordcount``   config #2 (cmd/urls shape): synthetic URL corpus →
+                  ScanReader → host parse → dict-encode → device Reduce,
+                  all through the Session (models/urls).
+- ``sortshuffle`` config #4: Reshuffle + per-shard device sort.
+- ``kmeans``      config #5: iterative Session k-means (Map with
+                  unbatched centroid arg + Reduce over a reused Result);
+                  raw jitted-step TFLOP/s noted as the MXU roofline.
+
+CPU baselines are numpy implementations of each workload measured on
+this host (BASELINE.md: the reference publishes no numbers; numpy is
+deliberately generous vs bigslice's per-record reflection). The device
+path runs the full SPMD pipeline on however many chips are visible.
+
+End-to-end modes assert that op groups actually engaged the device path
+(round-1 verdict: a silent fallback must not masquerade as a TPU
+number).
 """
 
 import json
@@ -21,10 +39,48 @@ import time
 import numpy as np
 
 
+def emit(metric: str, value: float, unit: str, baseline: float) -> None:
+    print(json.dumps({
+        "metric": metric,
+        "value": round(value, 1),
+        "unit": unit,
+        "vs_baseline": round(value / baseline, 3) if baseline else 0.0,
+    }))
 
 
+def note(msg: str) -> None:
+    print(f"bench: {msg}", file=sys.stderr)
 
-def cpu_baseline(keys: np.ndarray, vals: np.ndarray) -> float:
+
+def _mesh():
+    import jax
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    return Mesh(np.array(devs), ("shards",))
+
+
+def _mesh_session(mesh):
+    from bigslice_tpu.exec.meshexec import MeshExecutor
+    from bigslice_tpu.exec.session import Session
+
+    return Session(executor=MeshExecutor(mesh))
+
+
+def _bytes_roofline(metric: str, rows: int, row_bytes: int,
+                    secs: float, passes: int) -> None:
+    """HBM-traffic estimate for the sort-dominated pipelines: bytes
+    moved vs time, for comparison against the chip's HBM bandwidth
+    (v5e ≈ 819 GB/s; the sort pipeline is bandwidth-bound, not MXU-
+    bound, so bandwidth utilization is the roofline that matters)."""
+    gb = rows * row_bytes * passes / 1e9
+    note(f"{metric}: ~{gb:.2f} GB est. HBM traffic in {secs*1e3:.1f} ms "
+         f"→ {gb/secs:.0f} GB/s effective ({passes} passes × {row_bytes}B/row)")
+
+
+# ---------------------------------------------------------------- reduce
+
+def cpu_reduce_baseline(keys: np.ndarray, vals: np.ndarray) -> float:
     """rows/sec for numpy sort-based reduce-by-key (single core)."""
     t0 = time.perf_counter()
     order = np.argsort(keys, kind="stable")
@@ -36,16 +92,13 @@ def cpu_baseline(keys: np.ndarray, vals: np.ndarray) -> float:
     return len(keys) / dt
 
 
-def device_bench(keys: np.ndarray, vals: np.ndarray, iters: int = 5):
-    """rows/sec for the SPMD mesh reduce (all visible devices)."""
+def reduce_kernel_bench(keys, vals, iters: int = 5):
     import jax
-    from jax.sharding import Mesh
 
     from bigslice_tpu.parallel import shuffle as shuffle_mod
 
-    devs = jax.devices()
-    n = len(devs)
-    mesh = Mesh(np.array(devs), ("shards",))
+    mesh = _mesh()
+    n = mesh.devices.size
     total = len(keys)
     per = total // n
     cap = per
@@ -60,40 +113,84 @@ def device_bench(keys: np.ndarray, vals: np.ndarray, iters: int = 5):
     )
 
     def run_once():
-        k_out, v_out, out_counts, overflow = red(
-            [cols[0]], [cols[1]], counts
-        )
+        k_out, v_out, out_counts, overflow = red([cols[0]], [cols[1]],
+                                                 counts)
         jax.block_until_ready(v_out[0])
         return out_counts, overflow
 
     out_counts, overflow = run_once()  # compile + warm
     if int(np.asarray(overflow)) != 0:
-        print("warning: shuffle overflow in bench", file=sys.stderr)
+        note("warning: shuffle overflow in reduce-kernel bench")
     times = []
     for _ in range(iters):
         t0 = time.perf_counter()
         run_once()
         times.append(time.perf_counter() - t0)
     best = min(times)
-    return (n * per) / best, int(np.asarray(out_counts).sum())
+    # Pipeline passes over the working set (rows×8B for key+val int32):
+    # ~4 sorts (combine, bucket, final combine×2 operand groups) + a2a.
+    _bytes_roofline("reduce_kernel", n * per, 8, best, passes=10)
+    return (n * per) / best
 
 
-def join_bench(n_rows: int, iters: int = 3):
-    """rows/sec for the device join (reduce both sides + align): the
-    BASELINE Reduce+Cogroup headline shape.
+def reduce_e2e_bench(keys, vals, iters: int = 3):
+    """End-to-end: Session + MeshExecutor + result scan, fresh slices
+    per iteration (compile caches warm after iteration 0 — the
+    iterative-driver steady state)."""
+    import bigslice_tpu as bs
 
-    Note: the CPU baseline (np.unique per side) is a much lighter
-    operation than the full two-sided shuffle+align — the vs_baseline
-    ratio is only meaningful on TPU hardware."""
+    mesh = _mesh()
+    sess = _mesh_session(mesh)
+    n = mesh.devices.size
+
+    def add(a, b):
+        return a + b
+
+    def run_once():
+        # Stable fn identity across iterations: program/jit caches key
+        # on id(fn), so rebuilding the slice each round reuses the
+        # compiled SPMD program (the iterative-driver steady state).
+        r = bs.Reduce(bs.Const(n, keys, vals), add)
+        res = sess.run(r)
+        total = 0
+        for f in res.frames():
+            total += len(f)
+        res.discard()
+        return total
+
+    run_once()  # warm compile caches
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        distinct = run_once()
+        times.append(time.perf_counter() - t0)
+    if sess.executor.device_group_count() == 0:
+        raise RuntimeError("e2e reduce never engaged the device path")
+    best = min(times)
+    note(f"reduce_e2e: {distinct} distinct keys, "
+         f"device groups {sess.executor.device_group_count()}")
+    _bytes_roofline("reduce_e2e", len(keys), 8, best, passes=12)
+    return len(keys) / best
+
+
+# ------------------------------------------------------------------ join
+
+def cpu_join_baseline(ak, bk) -> float:
+    t0 = time.perf_counter()
+    ka, ca = np.unique(ak, return_counts=True)
+    kb, cb = np.unique(bk, return_counts=True)
+    np.intersect1d(ka, kb, assume_unique=True)
+    return (len(ak) + len(bk)) / (time.perf_counter() - t0)
+
+
+def join_kernel_bench(n_rows: int, iters: int = 3):
     import jax
-    from jax.sharding import Mesh
 
     from bigslice_tpu.parallel import join as join_mod
     from bigslice_tpu.parallel import shuffle as shuffle_mod
 
-    devs = jax.devices()
-    n = len(devs)
-    mesh = Mesh(np.array(devs), ("shards",))
+    mesh = _mesh()
+    n = mesh.devices.size
     per = n_rows // n
     nkeys = max(16, n_rows // 16)
 
@@ -117,8 +214,7 @@ def join_bench(n_rows: int, iters: int = 3):
 
     out = run_once()  # warm
     if int(np.asarray(out[4])) != 0:
-        print("warning: join shuffle overflow — throughput excludes "
-              "dropped rows", file=sys.stderr)
+        note("warning: join overflow — throughput excludes dropped rows")
     times = []
     for _ in range(iters):
         t0 = time.perf_counter()
@@ -127,18 +223,197 @@ def join_bench(n_rows: int, iters: int = 3):
     return (2 * n * per) / min(times)
 
 
-def cpu_join_baseline(n_rows: int) -> float:
-    rng1 = np.random.RandomState(1)
-    rng2 = np.random.RandomState(2)
-    nkeys = max(16, n_rows // 16)
-    a = rng1.randint(0, nkeys, n_rows).astype(np.int32)
-    b = rng2.randint(0, nkeys, n_rows).astype(np.int32)
-    t0 = time.perf_counter()
-    ka, ca = np.unique(a, return_counts=True)
-    kb, cb = np.unique(b, return_counts=True)
-    np.intersect1d(ka, kb, assume_unique=True)
-    return 2 * n_rows / (time.perf_counter() - t0)
+def join_e2e_bench(n_rows: int, iters: int = 3):
+    """Config #3 end-to-end: JoinAggregate through the Session — the
+    BASELINE 'Reduce+Cogroup join' headline, host rows in, scan out."""
+    import bigslice_tpu as bs
 
+    mesh = _mesh()
+    sess = _mesh_session(mesh)
+    n = mesh.devices.size
+    nkeys = max(16, n_rows // 16)
+    r1, r2 = np.random.RandomState(1), np.random.RandomState(2)
+    ak = r1.randint(0, nkeys, n_rows).astype(np.int32)
+    bk = r2.randint(0, nkeys, n_rows).astype(np.int32)
+    ones = np.ones(n_rows, np.int32)
+
+    def add(a, b):
+        return a + b
+
+    def run_once():
+        j = bs.JoinAggregate(
+            bs.Const(n, ak, ones), bs.Const(n, bk, ones), add, add,
+        )
+        res = sess.run(j)
+        total = 0
+        for f in res.frames():
+            total += len(f)
+        res.discard()
+        return total
+
+    run_once()
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        matched = run_once()
+        times.append(time.perf_counter() - t0)
+    if sess.executor.device_group_count() == 0:
+        raise RuntimeError("e2e join never engaged the device path")
+    best = min(times)
+    note(f"join_e2e: {matched} matched keys, device groups "
+         f"{sess.executor.device_group_count()}")
+    return 2 * n_rows / best
+
+
+# ------------------------------------------------------------- wordcount
+
+def _synth_urls(n_rows: int):
+    """Zipf-distributed synthetic URL corpus (cmd/urls workload shape)."""
+    rng = np.random.RandomState(7)
+    doms = (rng.zipf(1.5, n_rows) % 5000).astype(np.int64)
+    return [f"http://site{d}.example.com/p/{i & 1023}"
+            for i, d in enumerate(doms.tolist())]
+
+
+def cpu_wordcount_baseline(lines) -> float:
+    """Host dict count over parsed domains — what a tuned single-core
+    Python/bigslice-local run of cmd/urls does."""
+    from collections import Counter
+
+    from bigslice_tpu.models.urls import _domain
+
+    t0 = time.perf_counter()
+    Counter(_domain(u) for u in lines)
+    return len(lines) / (time.perf_counter() - t0)
+
+
+def wordcount_bench(n_rows: int, iters: int = 2):
+    """Config #2 (cmd/urls): ReaderFunc → host Map(parse) → dict-encode
+    → device Reduce, via models/urls.domain_count_encoded — the full
+    two-tier pipeline, host parsing included."""
+    from bigslice_tpu.models.urls import domain_count_encoded
+
+    lines = _synth_urls(n_rows)
+    mesh = _mesh()
+    n = mesh.devices.size
+
+    def source():
+        # ScanReader contract: a no-arg line iterator; shards stripe it.
+        yield from lines
+
+    def run_once():
+        sess = _mesh_session(mesh)
+        counts = domain_count_encoded(sess, n, source)
+        return sess, len(counts)
+
+    run_once()
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        sess, distinct = run_once()
+        times.append(time.perf_counter() - t0)
+    if sess.executor.device_group_count() == 0:
+        raise RuntimeError("wordcount never engaged the device path")
+    note(f"wordcount: {distinct} distinct domains, device groups "
+         f"{sess.executor.device_group_count()}")
+    return len(lines) / min(times), cpu_wordcount_baseline(lines)
+
+
+# ----------------------------------------------------------- sortshuffle
+
+def cpu_sortshuffle_baseline(keys: np.ndarray) -> float:
+    t0 = time.perf_counter()
+    np.sort(keys, kind="stable")
+    return len(keys) / (time.perf_counter() - t0)
+
+
+def sortshuffle_bench(n_rows: int, iters: int = 3):
+    """Config #4: Reshuffle + sorted scan — rows hash-route to their
+    partition, each partition sorts on device (sortio in-run device
+    sort via Frame.sorted_by_key)."""
+    import bigslice_tpu as bs
+
+    rng = np.random.RandomState(11)
+    keys = rng.randint(0, 1 << 30, n_rows).astype(np.int32)
+    mesh = _mesh()
+    sess = _mesh_session(mesh)
+    n = mesh.devices.size
+
+    def run_once():
+        shuf = bs.Reshuffle(bs.Const(n, keys))
+        res = sess.run(shuf)
+        total = 0
+        for shard in range(res.num_shards):
+            for f in res.reader(shard, ()):
+                total += len(f.sorted_by_key())
+        res.discard()
+        return total
+
+    assert run_once() == n_rows
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        run_once()
+        times.append(time.perf_counter() - t0)
+    if sess.executor.device_group_count() == 0:
+        raise RuntimeError("sortshuffle never engaged the device path")
+    return n_rows / min(times), cpu_sortshuffle_baseline(keys)
+
+
+# ---------------------------------------------------------------- kmeans
+
+def kmeans_bench(n_points: int, d: int, k: int, rounds: int = 3,
+                 fallback: bool = False):
+    """Config #5: iterative k-means *through the framework* — repeated
+    sess.run of Map(assign, centroids as unbatched arg) + Reduce over a
+    reused Result (models/kmeans.kmeans; the exec/compile.go:226
+    Result-reuse pattern). Also notes the raw jitted-step TFLOP/s (the
+    MXU roofline the framework path is converging toward)."""
+    import jax
+
+    from bigslice_tpu.models.kmeans import kmeans, kmeans_step
+
+    rng = np.random.RandomState(0)
+    pts = rng.rand(n_points, d).astype(np.float32)
+
+    # Roofline reference: the raw jitted step (not the framework).
+    cents = pts[:k].copy()
+    step = jax.jit(kmeans_step)
+    cents = np.asarray(step(pts, cents))  # warm
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        cents = step(pts, cents)
+    jax.block_until_ready(cents)
+    raw_dt = time.perf_counter() - t0
+    flops = 2.0 * n_points * d * k * 2 * rounds  # two matmuls/round
+    note(f"kmeans raw step: {flops/raw_dt/1e12:.2f} TFLOP/s "
+         f"({rounds} rounds, {n_points}x{d}, k={k})")
+
+    # The measured metric: the Session-driven iterative pipeline.
+    mesh = _mesh()
+    sess = _mesh_session(mesh)
+    n = mesh.devices.size
+    kmeans(sess, pts, k=k, iters=1, num_shards=n)  # warm compiles
+    t0 = time.perf_counter()
+    kmeans(sess, pts, k=k, iters=rounds, num_shards=n)
+    dt = time.perf_counter() - t0
+    if sess.executor.device_group_count() == 0:
+        raise RuntimeError("kmeans never engaged the device path")
+    note(f"kmeans session path: {n_points*rounds/dt:.0f} points/s, "
+         f"device groups {sess.executor.device_group_count()}")
+
+    # CPU baseline: numpy one round, scaled.
+    t0 = time.perf_counter()
+    d2 = ((pts ** 2).sum(1)[:, None]
+          + (np.asarray(cents) ** 2).sum(1)[None, :]
+          - 2 * pts @ np.asarray(cents).T)
+    assign = d2.argmin(1)
+    np.add.at(np.zeros((k, d), np.float32), assign, pts)
+    base_dt = time.perf_counter() - t0
+    return (n_points * rounds) / dt, n_points / base_dt
+
+
+# ------------------------------------------------------------------ main
 
 def main():
     from bigslice_tpu.utils.hermetic import ensure_usable_backend
@@ -148,39 +423,67 @@ def main():
     # wedged-tunnel fallback) scale down so the driver still gets its
     # JSON line in bounded time.
     fallback = backend in ("cpu", "cpu-fallback")
-    mode = "reduce"
     args = sys.argv[1:]
-    if args and args[0] in ("reduce", "join"):
+    mode = "reduce"
+    known = ("reduce", "reduce-kernel", "join", "join-kernel",
+             "wordcount", "sortshuffle", "kmeans")
+    if args and args[0] in known:
         mode = args.pop(0)
-    if mode == "join":
-        n_rows = int(args[0]) if args else (
-            1 << 19 if fallback else 1 << 23)
-        dev = join_bench(n_rows)
-        base = cpu_join_baseline(n_rows)
-        print(json.dumps({
-            "metric": "join_aggregate_rows_per_sec",
-            "value": round(dev, 1),
-            "unit": "rows/sec",
-            "vs_baseline": round(dev / base, 3),
-        }))
-        return
-    n_rows = int(args[0]) if args else (
-        1 << 21 if fallback else 1 << 24)  # 2M fallback / 16.7M TPU
-    n_keys = 1 << 16
-    rng = np.random.RandomState(42)
-    keys = rng.randint(0, n_keys, n_rows).astype(np.int32)
-    vals = np.ones(n_rows, dtype=np.int32)
+    size = int(args[0]) if args else None
 
-    base = cpu_baseline(keys, vals)
-    dev, distinct = device_bench(keys, vals)
-    assert distinct <= n_keys
-
-    print(json.dumps({
-        "metric": "reduce_by_key_rows_per_sec",
-        "value": round(dev, 1),
-        "unit": "rows/sec",
-        "vs_baseline": round(dev / base, 3),
-    }))
+    if mode == "reduce":
+        n_rows = size or (1 << 21 if fallback else 1 << 24)
+        n_keys = 1 << 16
+        rng = np.random.RandomState(42)
+        keys = rng.randint(0, n_keys, n_rows).astype(np.int32)
+        vals = np.ones(n_rows, dtype=np.int32)
+        base = cpu_reduce_baseline(keys, vals)
+        dev = reduce_e2e_bench(keys, vals)
+        emit("reduce_by_key_e2e_rows_per_sec", dev, "rows/sec", base)
+    elif mode == "reduce-kernel":
+        n_rows = size or (1 << 21 if fallback else 1 << 24)
+        rng = np.random.RandomState(42)
+        keys = rng.randint(0, 1 << 16, n_rows).astype(np.int32)
+        vals = np.ones(n_rows, dtype=np.int32)
+        base = cpu_reduce_baseline(keys, vals)
+        dev = reduce_kernel_bench(keys, vals)
+        emit("reduce_by_key_rows_per_sec", dev, "rows/sec", base)
+    elif mode == "join":
+        n_rows = size or (1 << 18 if fallback else 1 << 23)
+        dev = join_e2e_bench(n_rows)
+        r1, r2 = np.random.RandomState(1), np.random.RandomState(2)
+        nk = max(16, n_rows // 16)
+        base = cpu_join_baseline(
+            r1.randint(0, nk, n_rows).astype(np.int32),
+            r2.randint(0, nk, n_rows).astype(np.int32),
+        )
+        emit("join_aggregate_e2e_rows_per_sec", dev, "rows/sec", base)
+    elif mode == "join-kernel":
+        n_rows = size or (1 << 19 if fallback else 1 << 23)
+        dev = join_kernel_bench(n_rows)
+        r1, r2 = np.random.RandomState(1), np.random.RandomState(2)
+        nk = max(16, n_rows // 16)
+        base = cpu_join_baseline(
+            r1.randint(0, nk, n_rows).astype(np.int32),
+            r2.randint(0, nk, n_rows).astype(np.int32),
+        )
+        emit("join_aggregate_rows_per_sec", dev, "rows/sec", base)
+    elif mode == "wordcount":
+        n_rows = size or (1 << 20 if fallback else 1 << 24)
+        dev, base = wordcount_bench(n_rows)
+        emit("wordcount_rows_per_sec", dev, "rows/sec", base)
+    elif mode == "sortshuffle":
+        n_rows = size or (1 << 20 if fallback else 1 << 24)
+        dev, base = sortshuffle_bench(n_rows)
+        emit("shuffle_sort_rows_per_sec", dev, "rows/sec", base)
+    elif mode == "kmeans":
+        # Framework-path sizes: the Session pipeline carries points as d
+        # scalar columns through sort-based reduces, so the config
+        # scales d down from the raw-MXU shape on the CPU fallback.
+        n_points = size or (1 << 13 if fallback else 1 << 17)
+        d, k = (8, 8) if fallback else (64, 64)
+        dev, base = kmeans_bench(n_points, d=d, k=k, fallback=fallback)
+        emit("kmeans_points_per_sec", dev, "points/sec", base)
 
 
 if __name__ == "__main__":

@@ -44,6 +44,7 @@ from bigslice_tpu.ops.mapops import Map, MapBatches, Filter, Flatmap, Head, Scan
 from bigslice_tpu.ops.reduce import Reduce
 from bigslice_tpu.ops.fold import Fold
 from bigslice_tpu.ops.cogroup import Cogroup
+from bigslice_tpu.ops.join import JoinAggregate
 from bigslice_tpu.ops.groupby import GroupByKey
 from bigslice_tpu.ops.reshuffle import Reshuffle, Repartition, Reshard
 from bigslice_tpu.ops.cache import Cache, CachePartial, ReadCache
@@ -76,6 +77,7 @@ __all__ = [
     "Reduce",
     "Fold",
     "Cogroup",
+    "JoinAggregate",
     "GroupByKey",
     "Reshuffle",
     "Repartition",

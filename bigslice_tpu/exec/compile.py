@@ -112,9 +112,9 @@ class Compiler:
         # their output into num_tasks partitions and take the consumer's
         # combiner (map-side combining).
         dep_task_lists: List[Tuple[List[Task], object, Partitioner]] = []
-        for dep in innermost.deps():
+        for dep_index, dep in enumerate(innermost.deps()):
             if dep.shuffle:
-                comb = _frame_combiner(innermost)
+                comb = _frame_combiner(innermost, dep_index)
                 combine_key = ""
                 if self.machine_combiners and comb is not None:
                     # Deterministic per (dep slice, partitioning, fn):
@@ -234,7 +234,12 @@ class Compiler:
         return adapters
 
 
-def _frame_combiner(consumer: Slice):
+def _frame_combiner(consumer: Slice, dep_index: int = 0):
+    # Consumers with per-dep combiners (JoinAggregate: each side reduces
+    # with its own fn) expose a frame_combiners tuple parallel to deps().
+    fcs = getattr(consumer, "frame_combiners", None)
+    if fcs is not None:
+        return fcs[dep_index]
     comb = consumer.combiner()
     if comb is None:
         return None
@@ -245,7 +250,7 @@ def _frame_combiner(consumer: Slice):
         return fc
     from bigslice_tpu.ops.reduce import FrameCombiner
 
-    return FrameCombiner(comb.fn, consumer.deps()[0].slice.schema)
+    return FrameCombiner(comb.fn, consumer.deps()[dep_index].slice.schema)
 
 
 def _is_jax_stage(s: Slice) -> bool:

@@ -259,6 +259,7 @@ class MeshExecutor:
             if len(dep.tasks) not in (1, self.nmesh):
                 return False
         from bigslice_tpu.ops.const import Const
+        from bigslice_tpu.ops.join import JoinAggregate
         from bigslice_tpu.ops.mapops import Filter, Head, Map, _PrefixedSlice
         from bigslice_tpu.ops.reduce import Reduce
         from bigslice_tpu.ops.reshuffle import Reshard, Reshuffle
@@ -279,6 +280,19 @@ class MeshExecutor:
                 continue
             if isinstance(s, Reduce):
                 if not s.frame_combiner.device:
+                    return False
+                continue
+            if isinstance(s, JoinAggregate):
+                # Two-input stage: only as the chain's innermost (it
+                # consumes the raw dep inputs); both sides' combine fns
+                # must lower to the segmented-scan kernel and both dep
+                # schemas must be scalar-device.
+                if s is not task.chain[-1]:
+                    return False
+                if not all(fc.device for fc in s.frame_combiners):
+                    return False
+                if not all(ct.is_device and ct.shape == ()
+                           for d in s.deps() for ct in d.slice.schema):
                     return False
                 continue
             return False
@@ -375,7 +389,18 @@ class MeshExecutor:
 
     def _execute_group(self, key, tasks: List[Task]) -> None:
         task0 = tasks[0]
-        cols, counts, capacity = self._group_input(tasks)
+        inputs = self._group_inputs(tasks)
+        caps = tuple(c for _, _, c in inputs)
+        counts_list = [c for _, c, _ in inputs]
+        cols_flat = [c for colset, _, _ in inputs for c in colset]
+        # A join stage concatenates its two inputs; the chain's working
+        # buffer size from there on is the sum of the input capacities.
+        from bigslice_tpu.ops.join import JoinAggregate
+
+        base_capacity = (
+            sum(caps) if isinstance(task0.chain[-1], JoinAggregate)
+            else caps[0]
+        )
         # Skew handling: retry with geometrically larger per-destination
         # bucket slack; slack == nmesh makes overflow impossible (a
         # source can send at most `capacity` rows to one destination).
@@ -384,14 +409,14 @@ class MeshExecutor:
         # shapes.
         slack = 2.0
         while True:
-            program, stages = self._program(task0, capacity, slack)
+            program, stages = self._program(task0, caps, slack)
             extras = [
                 np.asarray(a)
                 for kind, _, s in stages if kind == "map"
                 for a in s.args
             ]
             out_counts, overflow, out_cols = program(
-                counts, *cols, *extras
+                *counts_list, *cols_flat, *extras
             )
             has_shuffle = any(k == "shuffle" for k, _, _ in stages)
             if not has_shuffle or int(np.asarray(overflow)) == 0:
@@ -404,40 +429,47 @@ class MeshExecutor:
             slack = min(slack * 4, float(self.nmesh))
         out_capacity = (
             self.nmesh
-            * shuffle_mod.send_capacity(capacity, self.nmesh, slack)
-            if has_shuffle else capacity
+            * shuffle_mod.send_capacity(base_capacity, self.nmesh, slack)
+            if has_shuffle else base_capacity
         )
         self._outputs[key] = DeviceGroupOutput(
             list(out_cols), out_counts, out_capacity, task0.schema,
             partitioned=task0.num_partition > 1,
         )
 
-    def _group_input(self, tasks: List[Task]):
-        """Build (global cols, counts, capacity) for the group's input."""
+    def _group_inputs(self, tasks: List[Task]):
+        """Build [(global cols, counts, capacity)] — one entry per dep
+        (or one host-source upload for dependency-less chains)."""
         task0 = tasks[0]
         if not task0.deps:
             # Host source: run each shard's reader, upload.
-            return self._upload(
+            return [self._upload(
                 [sliceio.read_all(
                     t.chain[-1].reader(t.name.shard, []),
                     t.chain[-1].schema,
                 ).to_host() for t in tasks]
-            )
-        # Single-dep chains only (multi-dep groups are ineligible).
-        pkey = task0.deps[0].tasks[0].group_key
+            )]
+        return [self._dep_input(tasks, i)
+                for i in range(len(task0.deps))]
+
+    def _dep_input(self, tasks: List[Task], dep_idx: int):
+        """(global cols, counts, capacity) for one dep of the group."""
+        task0 = tasks[0]
+        dep0 = task0.deps[dep_idx]
+        pkey = dep0.tasks[0].group_key
         out = self._outputs.get(pkey)
-        if out is not None and len(task0.deps[0].tasks) == self.nmesh:
+        if out is not None and len(dep0.tasks) == self.nmesh:
             # Device-resident shuffle output: device p already holds
             # partition p == consumer shard p. Zero-copy reuse.
             return out.cols, out.counts, out.capacity
-        if (out is not None and len(task0.deps[0].tasks) == 1
+        if (out is not None and len(dep0.tasks) == 1
                 and not out.partitioned):
             # Aligned (materialize-boundary) dep, device-resident.
             return out.cols, out.counts, out.capacity
         # Fallback-produced dep: load frames from the store per shard.
         per_shard_frames = []
         for t in tasks:
-            dep = t.deps[0]
+            dep = t.deps[dep_idx]
             frames = []
             for p in dep.tasks:
                 try:
@@ -466,6 +498,7 @@ class MeshExecutor:
     def _stages_for(self, task: Task) -> List[tuple]:
         """Flatten the chain (innermost→outermost) + output partitioner
         into device stage descriptors (kind, struct_id, slice)."""
+        from bigslice_tpu.ops.join import JoinAggregate
         from bigslice_tpu.ops.mapops import Filter, Head, Map
         from bigslice_tpu.ops.reduce import Reduce
 
@@ -481,6 +514,13 @@ class MeshExecutor:
                 fc = s.frame_combiner
                 stages.append(("combine", (id(fc.fn), fc.nkeys, fc.nvals),
                                s))
+            elif isinstance(s, JoinAggregate):
+                fa, fb = s.frame_combiners
+                stages.append((
+                    "join",
+                    (id(fa.fn), id(fb.fn), s.prefix, fa.nvals, fb.nvals),
+                    s,
+                ))
         if task.num_partition > 1:
             fc = task.partitioner.combiner
             stages.append((
@@ -490,9 +530,10 @@ class MeshExecutor:
             ))
         return stages
 
-    def _program(self, task: Task, capacity: int, slack: float = 2.0):
+    def _program(self, task: Task, caps: Tuple[int, ...],
+                 slack: float = 2.0):
         stages = self._stages_for(task)
-        key = (tuple((k, sid) for k, sid, _ in stages), capacity,
+        key = (tuple((k, sid) for k, sid, _ in stages), caps,
                task.num_partition, len(task.schema),
                self._input_ncols(task), slack)
         # The key embeds id()s of stage functions, which can recycle after
@@ -524,23 +565,65 @@ class MeshExecutor:
             len(s.args) for kind, _, s in stages if kind == "map"
         )
         in_ncols = self._input_ncols(task)
+        n_inputs = len(in_ncols)
 
         # Map-only chains never touch the mask; their final compaction
         # would be an identity permutation — skip it at trace time.
         mask_dirty = any(k != "map" for k, _, _ in stages)
 
-        def stepped(counts, *cols_and_extras):
+        def join_prelude(s, counts_list, col_sets):
+            """The two-input join stage: finish each side's keyed
+            reduction (per-device = global per key, since the producer
+            shuffles routed equal keys here), then align with the shared
+            tagged-sort kernel (parallel/join.make_align) — matched
+            (A,B) adjacent pairs become output rows."""
+            from bigslice_tpu.parallel.join import make_align
+
+            fcA, fcB = s.frame_combiners
+            nk = s.prefix
+            colsA, colsB = col_sets
+            nA, nB = counts_list[0][0], counts_list[1][0]
+            sizeA, sizeB = colsA[0].shape[0], colsB[0].shape[0]
+            maskA = jnp.arange(sizeA, dtype=np.int32) < nA
+            maskB = jnp.arange(sizeB, dtype=np.int32) < nB
+            coreA = segment.make_segmented_reduce_masked(
+                nk, fcA.nvals, segment.canonical_combine(fcA.fn, fcA.nvals)
+            )
+            coreB = segment.make_segmented_reduce_masked(
+                nk, fcB.nvals, segment.canonical_combine(fcB.fn, fcB.nvals)
+            )
+            keepA, kA, vA = coreA(maskA, tuple(colsA[:nk]),
+                                  tuple(colsA[nk:]))
+            keepB, kB, vB = coreB(maskB, tuple(colsB[:nk]),
+                                  tuple(colsB[nk:]))
+            align = make_align(nk, fcA.nvals, fcB.nvals)
+            return align(keepA, kA, vA, keepB, kB, vB)
+
+        def stepped(*counts_cols_extras):
             # Mask-chained stages: validity rides as a bool mask between
             # stages (no per-stage compaction sorts — filters and
             # combiners just update the mask); one final compaction sort
             # establishes the front-packed output contract.
-            n = counts[0]
-            cols = list(cols_and_extras[:in_ncols])
-            extras = list(cols_and_extras[in_ncols:])
-            size = cols[0].shape[0]
-            mask = jnp.arange(size, dtype=np.int32) < n
+            counts_list = counts_cols_extras[:n_inputs]
+            flat = counts_cols_extras[n_inputs:]
+            col_sets = []
+            off = 0
+            for nc in in_ncols:
+                col_sets.append(list(flat[off : off + nc]))
+                off += nc
+            extras = list(flat[off:])
             overflow = jnp.int32(0)
-            for kind, _, s in stages:
+            run_stages = stages
+            if stages and stages[0][0] == "join":
+                mask, cols = join_prelude(stages[0][2], counts_list,
+                                          col_sets)
+                run_stages = stages[1:]
+            else:
+                n = counts_list[0][0]
+                cols = col_sets[0]
+                size = cols[0].shape[0]
+                mask = jnp.arange(size, dtype=np.int32) < n
+            for kind, _, s in run_stages:
                 if kind == "map":
                     nargs = len(s.args)
                     stage_extras, extras = extras[:nargs], extras[nargs:]
@@ -591,7 +674,9 @@ class MeshExecutor:
                     cols = list(cols)
                     overflow = overflow + ov
             if not mask_dirty:
-                return (jnp.asarray(n).reshape(1), overflow, tuple(cols))
+                # Map-only single-input chain: counts pass through.
+                return (jnp.asarray(counts_list[0][0]).reshape(1),
+                        overflow, tuple(cols))
             # Final compaction to the front-packed (cols, count) contract.
             out_n, cols = segment.compact_by_mask(mask, cols)
             return (out_n.reshape(1), overflow, tuple(cols))
@@ -599,8 +684,8 @@ class MeshExecutor:
         ncols_out = len(task.schema)
         col_spec = P(axis)
         in_specs = (
-            (P(axis),)
-            + tuple(col_spec for _ in range(in_ncols))
+            tuple(P(axis) for _ in range(n_inputs))
+            + tuple(col_spec for _ in range(sum(in_ncols)))
             + tuple(P() for _ in range(n_extras))
         )
         out_specs = (P(axis), P(),
@@ -637,18 +722,21 @@ class MeshExecutor:
                 fns.append(s.pred)
             elif kind == "combine":
                 fns.append(s.frame_combiner.fn)
+            elif kind == "join":
+                fns.extend(fc.fn for fc in s.frame_combiners)
             elif kind == "shuffle":
                 fc = s.partitioner.combiner
                 if fc is not None:
                     fns.append(fc.fn)
         return fns
 
-    def _input_ncols(self, task: Task) -> int:
+    def _input_ncols(self, task: Task) -> Tuple[int, ...]:
+        """Per-input column counts (one entry per dep; one for sources)."""
         innermost = task.chain[-1]
         deps = innermost.deps()
         if deps:
-            return len(deps[0].slice.schema)
-        return len(innermost.schema)
+            return tuple(len(d.slice.schema) for d in deps)
+        return (len(innermost.schema),)
 
     # -- frame materialization for fallback/result consumers --------------
 

@@ -27,6 +27,59 @@ from bigslice_tpu.parallel.meshutil import get_shard_map, mesh_axis
 from bigslice_tpu.parallel import shuffle as shuffle_mod
 
 
+def make_align(nkeys: int, nvals_a: int, nvals_b: int):
+    """Build the tagged-sort align kernel shared by the kernel tier
+    (MeshJoinAggregate) and the Slice tier (meshexec join groups).
+
+    ``align(keep_a, key_cols_a, val_cols_a, keep_b, key_cols_b,
+    val_cols_b) -> (match_mask, out_cols)`` where each side's rows are
+    selected by its ``keep`` mask and have at most one row per key
+    (post-reduction). Sides are concatenated with a side tag, stable-
+    sorted by (validity, keys..., tag), and an inner-join match is an
+    adjacent valid (tag 0, tag 1) pair with equal keys. ``out_cols`` is
+    keys + A's values + B's values (shifted from the adjacent row),
+    valid where ``match_mask`` — callers compact or chain the mask.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    def align(keep_a, key_a, val_a, keep_b, key_b, val_b):
+        size_a = key_a[0].shape[0]
+        size_b = key_b[0].shape[0]
+        size = size_a + size_b
+        keys = [jnp.concatenate([x, y]) for x, y in zip(key_a, key_b)]
+        tag = jnp.concatenate([
+            jnp.zeros(size_a, np.int32), jnp.ones(size_b, np.int32)
+        ])
+        avals = [
+            jnp.concatenate([v, jnp.zeros((size_b,), v.dtype)])
+            for v in val_a
+        ]
+        bvals = [
+            jnp.concatenate([jnp.zeros((size_a,), v.dtype), v])
+            for v in val_b
+        ]
+        invalid = (~jnp.concatenate([keep_a, keep_b])).astype(np.int32)
+        ops = ((invalid,) + tuple(keys) + (tag,)
+               + tuple(avals) + tuple(bvals))
+        srt = lax.sort(ops, num_keys=2 + nkeys, is_stable=True)
+        s_inv, s_keys = srt[0], srt[1 : 1 + nkeys]
+        s_tag = srt[1 + nkeys]
+        s_avals = srt[2 + nkeys : 2 + nkeys + nvals_a]
+        s_bvals = srt[2 + nkeys + nvals_a :]
+        eq = jnp.ones(size - 1, dtype=bool)
+        for k in s_keys:
+            eq = eq & (k[:-1] == k[1:])
+        match = jnp.zeros(size, dtype=bool).at[:-1].set(
+            eq & (s_tag[:-1] == 0) & (s_tag[1:] == 1)
+            & (s_inv[:-1] == 0) & (s_inv[1:] == 0)
+        )
+        b_next = [jnp.concatenate([v[1:], v[-1:]]) for v in s_bvals]
+        return match, list(s_keys) + list(s_avals) + list(b_next)
+
+    return align
+
+
 class MeshJoinAggregate:
     """Inner-join two keyed, single-value-column sides after per-side
     reduction. ``__call__`` takes per-side (keys, vals, counts) global
@@ -57,39 +110,19 @@ class MeshJoinAggregate:
         cap_a = self.a_reduce.out_capacity
         cap_b = self.b_reduce.out_capacity
         self.out_capacity = cap_a + cap_b
+        align_core = make_align(1, 1, 1)
 
         def align(counts_a, counts_b, ka, va, kb, vb):
+            from bigslice_tpu.parallel.segment import compact_by_mask
+
             na = counts_a[0]
             nb = counts_b[0]
-            size = cap_a + cap_b
-            keys = jnp.concatenate([ka, kb])
-            tags = jnp.concatenate([
-                jnp.zeros(cap_a, np.int32), jnp.ones(cap_b, np.int32)
-            ])
-            vals = jnp.concatenate([va, vb])
-            valid = jnp.concatenate([
-                jnp.arange(cap_a, dtype=np.int32) < na,
-                jnp.arange(cap_b, dtype=np.int32) < nb,
-            ])
-            invalid = (~valid).astype(np.int32)
-            s = lax.sort((invalid, keys, tags, vals), num_keys=3,
-                         is_stable=True)
-            s_inv, s_keys, s_tags, s_vals = s
-            # A matched key appears as adjacent (tag 0, tag 1) rows.
-            match = jnp.zeros(size, dtype=bool)
-            match = match.at[:-1].set(
-                (s_keys[:-1] == s_keys[1:])
-                & (s_tags[:-1] == 0) & (s_tags[1:] == 1)
-                & (s_inv[:-1] == 0) & (s_inv[1:] == 0)
-            )
-            b_val_next = jnp.concatenate([s_vals[1:], s_vals[-1:]])
-            drop = (~match).astype(np.int32)
-            packed = lax.sort(
-                (drop, s_keys, s_vals, b_val_next), num_keys=1,
-                is_stable=True,
-            )
-            n_out = match.sum().astype(np.int32)
-            return (n_out.reshape(1), packed[1], packed[2], packed[3])
+            keep_a = jnp.arange(cap_a, dtype=np.int32) < na
+            keep_b = jnp.arange(cap_b, dtype=np.int32) < nb
+            match, cols = align_core(keep_a, (ka,), (va,),
+                                     keep_b, (kb,), (vb,))
+            n_out, packed = compact_by_mask(match, cols)
+            return (n_out.reshape(1),) + tuple(packed)
 
         col = P(axis)
         self._align = jax.jit(shard_map(
