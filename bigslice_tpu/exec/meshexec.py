@@ -234,9 +234,14 @@ class MeshExecutor:
     # -- eligibility ------------------------------------------------------
 
     def _eligible(self, task: Task) -> bool:
-        if task.chain is None or task.name.num_shard != self.nmesh:
+        # Padded-mesh groups: op shard counts up to the mesh size run
+        # SPMD with trailing devices holding empty shards (the S < N
+        # case; S > N groups still fall back pending wave scheduling).
+        # Output partition counts are independent of the shard count
+        # (Reshard changes them) but must also fit the mesh.
+        if task.chain is None or task.name.num_shard > self.nmesh:
             return False
-        if task.num_partition not in (1, self.nmesh):
+        if task.num_partition > self.nmesh:
             return False
         if not all(ct.is_device and ct.shape == ()
                    for ct in task.schema):
@@ -249,18 +254,28 @@ class MeshExecutor:
             # own (inherent) per-device combining, so these run fallback.
             return False
         if task.num_partition > 1:
-            if part.partition_fn is not None:
-                return False  # custom partitioners run host-tier (v1)
+            from bigslice_tpu.ops.reshuffle import RowPartitioner
+
+            if (part.partition_fn is not None
+                    and not isinstance(part.partition_fn,
+                                       RowPartitioner)):
+                return False  # frame-level host partitioners fall back
             if part.combiner is not None and not getattr(
                 part.combiner, "device", False
             ):
                 return False
         for dep in task.deps:
-            if len(dep.tasks) not in (1, self.nmesh):
+            if len(dep.tasks) > self.nmesh:
                 return False
         from bigslice_tpu.ops.const import Const
         from bigslice_tpu.ops.join import JoinAggregate
-        from bigslice_tpu.ops.mapops import Filter, Head, Map, _PrefixedSlice
+        from bigslice_tpu.ops.mapops import (
+            Filter,
+            Flatmap,
+            Head,
+            Map,
+            _PrefixedSlice,
+        )
         from bigslice_tpu.ops.reduce import Reduce
         from bigslice_tpu.ops.reshuffle import Reshard, Reshuffle
         from bigslice_tpu.ops.source import ReaderFunc
@@ -272,7 +287,7 @@ class MeshExecutor:
                            for ct in s.schema):
                     return False
                 continue
-            if isinstance(s, (Map, Filter)):
+            if isinstance(s, (Map, Filter, Flatmap)):
                 if s.mode != "jax":
                     return False
                 continue
@@ -393,14 +408,18 @@ class MeshExecutor:
         caps = tuple(c for _, _, c in inputs)
         counts_list = [c for _, c, _ in inputs]
         cols_flat = [c for colset, _, _ in inputs for c in colset]
-        # A join stage concatenates its two inputs; the chain's working
-        # buffer size from there on is the sum of the input capacities.
+        # A join stage concatenates its two inputs; flatmap stages grow
+        # the buffer by their fanout — track the working buffer size the
+        # chain carries into its output/shuffle stage.
         from bigslice_tpu.ops.join import JoinAggregate
 
         base_capacity = (
             sum(caps) if isinstance(task0.chain[-1], JoinAggregate)
             else caps[0]
         )
+        for st in self._stages_for(task0):
+            if st[0] == "flatmap":
+                base_capacity *= st[2].fanout
         # Skew handling: retry with geometrically larger per-destination
         # bucket slack; slack == nmesh makes overflow impossible (a
         # source can send at most `capacity` rows to one destination).
@@ -415,21 +434,34 @@ class MeshExecutor:
                 for kind, _, s in stages if kind == "map"
                 for a in s.args
             ]
-            out_counts, overflow, out_cols = program(
+            out_counts, overflow, badrange, out_cols = program(
                 *counts_list, *cols_flat, *extras
             )
             has_shuffle = any(k == "shuffle" for k, _, _ in stages)
+            if has_shuffle and int(np.asarray(badrange)) > 0:
+                # User error, not skew: match the host tier's range
+                # check (exec/local.py partition_frame) instead of
+                # burning slack retries.
+                raise ValueError(
+                    f"partitioner returned ids outside "
+                    f"[0, {task0.num_partition}) in group "
+                    f"{task0.name.op}"
+                )
             if not has_shuffle or int(np.asarray(overflow)) == 0:
                 break
-            if slack >= self.nmesh:
+            # slack == nparts makes overflow impossible (a source can
+            # send at most `capacity` rows to one destination).
+            full_slack = float(max(2, task0.num_partition))
+            if slack >= full_slack:
                 raise RuntimeError(
                     f"mesh shuffle overflow in group {task0.name.op} "
                     f"even at full slack"
                 )
-            slack = min(slack * 4, float(self.nmesh))
+            slack = min(slack * 4, full_slack)
         out_capacity = (
             self.nmesh
-            * shuffle_mod.send_capacity(base_capacity, self.nmesh, slack)
+            * shuffle_mod.send_capacity(base_capacity,
+                                        task0.num_partition, slack)
             if has_shuffle else base_capacity
         )
         self._outputs[key] = DeviceGroupOutput(
@@ -458,13 +490,15 @@ class MeshExecutor:
         dep0 = task0.deps[dep_idx]
         pkey = dep0.tasks[0].group_key
         out = self._outputs.get(pkey)
-        if out is not None and len(dep0.tasks) == self.nmesh:
+        if out is not None and out.partitioned:
             # Device-resident shuffle output: device p already holds
-            # partition p == consumer shard p. Zero-copy reuse.
+            # partition p == consumer shard p (for any producer shard
+            # count — routing is partition-addressed). Zero-copy reuse.
             return out.cols, out.counts, out.capacity
         if (out is not None and len(dep0.tasks) == 1
                 and not out.partitioned):
-            # Aligned (materialize-boundary) dep, device-resident.
+            # Aligned (materialize-boundary) dep, device-resident:
+            # device s holds producer shard s == consumer shard s.
             return out.cols, out.counts, out.capacity
         # Fallback-produced dep: load frames from the store per shard.
         per_shard_frames = []
@@ -484,6 +518,13 @@ class MeshExecutor:
         return self._upload(per_shard_frames)
 
     def _upload(self, per_shard_frames: List[Frame]):
+        # Padded-mesh groups (S < N shards): trailing devices carry
+        # empty shards.
+        per_shard_frames = list(per_shard_frames)
+        while len(per_shard_frames) < self.nmesh:
+            per_shard_frames.append(
+                Frame.empty(per_shard_frames[0].schema)
+            )
         counts = [len(f) for f in per_shard_frames]
         ncols = per_shard_frames[0].num_cols
         per_shard_cols = [
@@ -499,13 +540,15 @@ class MeshExecutor:
         """Flatten the chain (innermost→outermost) + output partitioner
         into device stage descriptors (kind, struct_id, slice)."""
         from bigslice_tpu.ops.join import JoinAggregate
-        from bigslice_tpu.ops.mapops import Filter, Head, Map
+        from bigslice_tpu.ops.mapops import Filter, Flatmap, Head, Map
         from bigslice_tpu.ops.reduce import Reduce
 
         stages: List[tuple] = []
         for s in reversed(task.chain):
             if isinstance(s, Map):
                 stages.append(("map", (id(s.fn), len(s.args)), s))
+            elif isinstance(s, Flatmap):
+                stages.append(("flatmap", (id(s.fn), s.fanout), s))
             elif isinstance(s, Filter):
                 stages.append(("filter", id(s.pred), s))
             elif isinstance(s, Head):
@@ -523,9 +566,12 @@ class MeshExecutor:
                 ))
         if task.num_partition > 1:
             fc = task.partitioner.combiner
+            pf = task.partitioner.partition_fn
             stages.append((
                 "shuffle",
-                (task.schema.prefix, id(fc.fn) if fc else None),
+                (task.schema.prefix, id(fc.fn) if fc else None,
+                 id(pf.fn) if pf is not None else None,
+                 task.num_partition),
                 task,
             ))
         return stages
@@ -613,6 +659,7 @@ class MeshExecutor:
                 off += nc
             extras = list(flat[off:])
             overflow = jnp.int32(0)
+            badrange = jnp.int32(0)
             run_stages = stages
             if stages and stages[0][0] == "join":
                 mask, cols = join_prelude(stages[0][2], counts_list,
@@ -635,6 +682,18 @@ class MeshExecutor:
                     if not isinstance(out, (tuple, list)):
                         out = (out,)
                     cols = [jnp.asarray(o) for o in out]
+                elif kind == "flatmap":
+                    # Fixed-fanout 1→k: vmapped fn yields [n, k] planes
+                    # (mask first); flatten row-major so each input
+                    # row's outputs stay contiguous, and with the row
+                    # validity folded into the plane mask.
+                    outs = jax.vmap(s.fn)(*cols)
+                    plane_mask = outs[0]
+                    mask = (mask[:, None] & plane_mask).reshape(-1)
+                    cols = [
+                        o.reshape(-1).astype(ct.dtype)
+                        for o, ct in zip(outs[1:], s.schema)
+                    ]
                 elif kind == "filter":
                     mask = mask & jax.vmap(s.pred)(*cols)
                 elif kind == "head":
@@ -667,19 +726,26 @@ class MeshExecutor:
                             tuple(cols[fc.nkeys :]),
                         )
                         cols = list(keys) + list(vals)
+                    pf = part.partition_fn
                     body = shuffle_mod.make_shuffle_fn(
-                        nmesh, nkeys, cols[0].shape[0], axis, slack=slack
+                        nmesh, nkeys, cols[0].shape[0], axis,
+                        slack=slack, nparts=s.num_partition,
+                        partition_fn=(
+                            pf.device_fn(s.num_partition)
+                            if pf is not None else None
+                        ),
                     )
-                    mask, ov, cols = body.masked(mask, *cols)
+                    mask, ov, nb, cols = body.masked(mask, *cols)
                     cols = list(cols)
                     overflow = overflow + ov
+                    badrange = badrange + nb
             if not mask_dirty:
                 # Map-only single-input chain: counts pass through.
                 return (jnp.asarray(counts_list[0][0]).reshape(1),
-                        overflow, tuple(cols))
+                        overflow, badrange, tuple(cols))
             # Final compaction to the front-packed (cols, count) contract.
             out_n, cols = segment.compact_by_mask(mask, cols)
-            return (out_n.reshape(1), overflow, tuple(cols))
+            return (out_n.reshape(1), overflow, badrange, tuple(cols))
 
         ncols_out = len(task.schema)
         col_spec = P(axis)
@@ -688,7 +754,7 @@ class MeshExecutor:
             + tuple(col_spec for _ in range(sum(in_ncols)))
             + tuple(P() for _ in range(n_extras))
         )
-        out_specs = (P(axis), P(),
+        out_specs = (P(axis), P(), P(),
                      tuple(col_spec for _ in range(ncols_out)))
         prog = jax.jit(
             shard_map(stepped, mesh=self.mesh, in_specs=in_specs,
@@ -716,7 +782,7 @@ class MeshExecutor:
         stage order (cache-validation identities)."""
         fns = []
         for kind, _, s in stages:
-            if kind == "map":
+            if kind in ("map", "flatmap"):
                 fns.append(s.fn)
             elif kind == "filter":
                 fns.append(s.pred)
@@ -728,6 +794,9 @@ class MeshExecutor:
                 fc = s.partitioner.combiner
                 if fc is not None:
                     fns.append(fc.fn)
+                pf = s.partitioner.partition_fn
+                if pf is not None:
+                    fns.append(pf.fn)
         return fns
 
     def _input_ncols(self, task: Task) -> Tuple[int, ...]:

@@ -41,7 +41,8 @@ def make_shuffle_fn(nshards: int, nkeys: int, capacity: int,
                     axis: str = "shards", seed: int = 0,
                     partition_fn: Optional[Callable] = None,
                     slack: float = 2.0,
-                    use_pallas: Optional[bool] = None):
+                    use_pallas: Optional[bool] = None,
+                    nparts: Optional[int] = None):
     """Build the per-device shuffle body (to be wrapped in shard_map).
 
     Operates on ``cols`` (each shape [capacity]) plus a valid-row count
@@ -51,16 +52,25 @@ def make_shuffle_fn(nshards: int, nkeys: int, capacity: int,
 
     ``partition_fn(*key_cols) -> int32 ids`` (vectorized, one positional
     arg per key column) overrides hash partitioning (Repartition
-    support). Ids outside [0, nshards) are dropped and counted into the
+    support). Ids outside [0, nparts) are dropped and counted into the
     overflow signal — same observability as the host executor's range
     check (exec/local.py partition_frame).
+
+    ``nparts`` (default ``nshards``) is the partition count the routing
+    modulo uses — it may be smaller than the mesh (padded-mesh groups:
+    a 5-shard op on an 8-device mesh routes to partitions 0..4 and
+    devices 5..7 receive nothing). It must agree with the host tier's
+    ``hash % nparts`` so mixed-tier dep edges stay consistent.
     """
     import jax.numpy as jnp
     from jax import lax
 
     from bigslice_tpu.frame import ops as frame_ops
 
-    send_cap = send_capacity(capacity, nshards, slack)
+    if nparts is None:
+        nparts = nshards
+    assert nparts <= nshards, (nparts, nshards)
+    send_cap = send_capacity(capacity, nparts, slack)
 
     def body_masked(valid, *cols):
         """Mask-based core: rows where ``valid`` route; returns
@@ -73,8 +83,8 @@ def make_shuffle_fn(nshards: int, nkeys: int, capacity: int,
             part = jnp.asarray(partition_fn(*keys)).astype(np.int32)
             # Out-of-range ids route to the drop lane and are counted in
             # the overflow signal rather than silently clipped.
-            bad = (part < 0) | (part >= nshards)
-            part = jnp.where(bad, np.int32(nshards), part)
+            bad = (part < 0) | (part >= nparts)
+            part = jnp.where(bad, np.int32(nparts), part)
         else:
             bad = None
             enable_pallas = use_pallas
@@ -91,16 +101,16 @@ def make_shuffle_fn(nshards: int, nkeys: int, capacity: int,
                 # XLA path below.
                 from bigslice_tpu.parallel import pallas_kernels as pk
 
-                part, _ = pk.hash_partition(keys[0], nshards, seed,
+                part, _ = pk.hash_partition(keys[0], nparts, seed,
                                             with_counts=False)
             else:
                 h = None
                 for k in keys:
                     kh = frame_ops.hash_device_column(k, seed)
                     h = kh if h is None else frame_ops.combine_hashes(h, kh)
-                part = (h % np.uint32(nshards)).astype(np.int32)
+                part = (h % np.uint32(nparts)).astype(np.int32)
         # Invalid rows route to a virtual shard that sorts last.
-        part = jnp.where(valid, part, np.int32(nshards))
+        part = jnp.where(valid, part, np.int32(nparts))
         n_bad = (
             jnp.int32(0) if bad is None
             else (bad & valid).sum().astype(np.int32)
@@ -113,19 +123,18 @@ def make_shuffle_fn(nshards: int, nkeys: int, capacity: int,
         s_cols = sorted_ops[1:]
 
         # Row counts per destination and bucket-local offsets.
-        counts = jnp.bincount(s_part, length=nshards + 1)[:nshards]
+        counts = jnp.bincount(s_part, length=nparts + 1)[:nparts]
         starts = jnp.concatenate(
             [jnp.zeros(1, np.int32),
              jnp.cumsum(counts).astype(np.int32)[:-1]]
         )
         offset = jnp.arange(size, dtype=np.int32) - jnp.take(
-            starts, jnp.minimum(s_part, nshards - 1)
+            starts, jnp.minimum(s_part, nparts - 1)
         )
-        overflow = jnp.maximum(counts.max() - send_cap, 0) + n_bad
 
         # Scatter into (nshards, send_cap) send buckets; rows beyond
         # capacity (or invalid) drop — reported via `overflow`.
-        in_bounds = (offset < send_cap) & (s_part < nshards)
+        in_bounds = (offset < send_cap) & (s_part < nparts)
         dest_row = jnp.where(in_bounds, s_part, nshards)  # drop lane
         dest_off = jnp.where(in_bounds, offset, 0)
         out_buckets = []
@@ -133,7 +142,12 @@ def make_shuffle_fn(nshards: int, nkeys: int, capacity: int,
             buf = jnp.zeros((nshards + 1, send_cap) + c.shape[1:], c.dtype)
             buf = buf.at[dest_row, dest_off].set(c, mode="drop")
             out_buckets.append(buf[:nshards])
-        send_counts = jnp.minimum(counts, send_cap).astype(np.int32)
+        send_counts = jnp.concatenate([
+            jnp.minimum(counts, send_cap).astype(np.int32),
+            jnp.zeros(nshards - nparts, np.int32),
+        ]) if nparts < nshards else jnp.minimum(
+            counts, send_cap
+        ).astype(np.int32)
 
         # The collectives: counts then data, one all_to_all each.
         recv_counts = lax.all_to_all(
@@ -150,18 +164,27 @@ def make_shuffle_fn(nshards: int, nkeys: int, capacity: int,
         row_in_bucket = jnp.arange(send_cap, dtype=np.int32)
         valid_mask = (row_in_bucket[None, :]
                       < recv_counts[:, None]).reshape(-1)
-        total_overflow = lax.psum(overflow, axis)
-        return valid_mask, total_overflow, out_cols
+        # Bucket overflow (capacity skew — caller retries with slack)
+        # and out-of-range partitioner ids (a user error — caller should
+        # raise, matching the host tier's range check) surface as
+        # separate global signals.
+        total_overflow = lax.psum(
+            jnp.maximum(counts.max() - send_cap, 0), axis
+        )
+        total_bad = lax.psum(n_bad, axis)
+        return valid_mask, total_overflow, total_bad, out_cols
 
     def body(n, *cols):
         from bigslice_tpu.parallel.segment import compact_by_mask
 
         size = cols[0].shape[0]
         valid = jnp.arange(size, dtype=np.int32) < n
-        valid_mask, total_overflow, out_cols = body_masked(valid, *cols)
+        valid_mask, total_overflow, total_bad, out_cols = body_masked(
+            valid, *cols
+        )
         # Compact valid rows to the front (count-based output contract).
         out_count, out_cols = compact_by_mask(valid_mask, out_cols)
-        return out_count, total_overflow, list(out_cols)
+        return out_count, total_overflow + total_bad, list(out_cols)
 
     body.masked = body_masked
     return body
@@ -260,8 +283,9 @@ class MeshReduceByKey:
             mask0 = jnp.arange(size, dtype=np.int32) < n
             # 1. map-side combine (uncompacted; survivor mask)
             keep1, k1, v1 = combine_masked(mask0, key_cols, val_cols)
-            # 2. shuffle by key hash (mask in, mask out)
-            recv_mask, overflow, out_cols = shuffle_body.masked(
+            # 2. shuffle by key hash (mask in, mask out; hash routing
+            # can't produce out-of-range ids, so `bad` is dropped)
+            recv_mask, overflow, _bad, out_cols = shuffle_body.masked(
                 keep1, *(tuple(k1) + tuple(v1))
             )
             k2 = tuple(out_cols[:nkeys])

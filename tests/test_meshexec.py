@@ -101,9 +101,10 @@ def test_host_producer_mesh_consumer(sess):
     assert dict(res.rows()) == {0: 20, 1: 20, 2: 20, 3: 20}
 
 
-def test_shard_count_mismatch_falls_back(mesh):
+def test_small_shard_count_runs_padded(mesh):
     sess = Session(executor=MeshExecutor(mesh))
-    # 5 shards on an 8-device mesh: not eligible, runs on fallback.
+    # 5 shards on an 8-device mesh: runs SPMD with 3 empty-padded
+    # devices (routing modulo 5, matching the host tier).
     r = bs.Reduce(
         bs.Const(5, np.arange(50, dtype=np.int32) % 7,
                  np.ones(50, dtype=np.int32)),
@@ -111,6 +112,21 @@ def test_shard_count_mismatch_falls_back(mesh):
     )
     res = sess.run(r)
     assert dict(res.rows()) == {i: 50 // 7 + (1 if i < 50 % 7 else 0)
+                                for i in range(7)}
+    assert sess.executor.device_group_count() >= 2
+
+
+def test_large_shard_count_falls_back(mesh):
+    sess = Session(executor=MeshExecutor(mesh))
+    # 11 shards exceed the 8-device mesh: falls back (wave scheduling
+    # not implemented), stays correct.
+    r = bs.Reduce(
+        bs.Const(11, np.arange(110, dtype=np.int32) % 7,
+                 np.ones(110, dtype=np.int32)),
+        lambda a, b: a + b,
+    )
+    res = sess.run(r)
+    assert dict(res.rows()) == {i: 110 // 7 + (1 if i < 110 % 7 else 0)
                                 for i in range(7)}
     assert sess.executor.device_group_count() == 0
 
@@ -302,3 +318,107 @@ def test_program_cache_guards_recycled_fn_ids(mesh):
     ex._programs[key] = ("stale", (dead,))
     prog2, _ = ex._program(task, (8,))
     assert prog2 != "stale"
+
+
+def test_fixed_fanout_flatmap_on_mesh(mesh):
+    """Fixed-fanout Flatmap lowers to a device stage (plane-flatten +
+    mask), including a downstream shuffle sized for the fanout."""
+    import jax.numpy as jnp
+
+    sess = Session(executor=MeshExecutor(mesh))
+
+    def dup(x):
+        # Emit x and x+1000; drop the second when x is odd.
+        mask = jnp.array([True, True]) & jnp.array([True, False]) | (
+            jnp.array([False, True]) & (x % 2 == 0)
+        )
+        return mask, jnp.stack([x, x + 1000])
+
+    src = bs.Const(8, np.arange(64, dtype=np.int32))
+    fm = bs.Flatmap(src, dup, out=[np.int32], fanout=2)
+    r = bs.Reduce(bs.Map(fm, lambda x: (x % 4, x)),
+                  lambda a, b: a + b)
+    res = sess.run(r)
+    oracle = {}
+    for x in range(64):
+        outs = [x] + ([x + 1000] if x % 2 == 0 else [])
+        for o in outs:
+            oracle[o % 4] = oracle.get(o % 4, 0) + o
+    assert dict(res.rows()) == oracle
+    assert sess.executor.device_group_count() >= 2
+
+
+def test_device_repartition_on_mesh(mesh):
+    """A traceable row partitioner runs inside the mesh shuffle kernel
+    (round-1 verdict: kernel support existed but was unreachable)."""
+    sess = Session(executor=MeshExecutor(mesh))
+
+    def by_range(k, nparts):
+        return (k * nparts) // 64
+
+    src = bs.Const(8, np.arange(64, dtype=np.int32))
+    rp = bs.Repartition(src, by_range)
+    res = sess.run(rp)
+    assert sorted(res.rows()) == [(i,) for i in range(64)]
+    assert sess.executor.device_group_count() >= 1
+    # Partition placement: shard s must hold exactly the range block s.
+    for shard in range(8):
+        vals = sorted(
+            v for f in res.reader(shard, ()) for (v,) in f.rows()
+        )
+        assert vals == list(range(shard * 8, (shard + 1) * 8))
+
+
+def test_repartition_matches_local(mesh):
+    """Device and host tiers evaluate the same traced partitioner, so
+    placement agrees exactly across executors."""
+    def by_mod3(k, nparts):
+        return (k * 7 + 3) % nparts
+
+    def build():
+        return bs.Repartition(
+            bs.Const(8, np.arange(48, dtype=np.int32)), by_mod3
+        )
+
+    local = Session()
+    meshs = Session(executor=MeshExecutor(mesh))
+    rl = local.run(build())
+    rm = meshs.run(build())
+    for shard in range(8):
+        lv = sorted(v for f in rl.reader(shard, ())
+                    for (v,) in f.rows())
+        mv = sorted(v for f in rm.reader(shard, ())
+                    for (v,) in f.rows())
+        assert lv == mv
+
+
+def test_reshard_down_on_mesh(mesh):
+    """Reshard to a smaller shard count: the producer's shuffle routes
+    modulo nparts=3 on the device with idle trailing devices."""
+    sess = Session(executor=MeshExecutor(mesh))
+    src = bs.Const(8, np.arange(64, dtype=np.int32))
+    rs = bs.Reshard(bs.Prefixed(src, 1), 3)
+    res = sess.run(rs)
+    assert sorted(res.rows()) == [(i,) for i in range(64)]
+    assert res.num_shards == 3
+    # BOTH groups device-resident: the 8-shard producer with its
+    # 3-partition shuffle AND the 3-shard consumer (non-vacuous: the
+    # producer is the one exercising nparts < nmesh routing).
+    assert sess.executor.device_group_count() >= 2
+
+
+def test_device_partitioner_range_error(mesh):
+    """Out-of-range ids from a device partitioner raise the host
+    tier's range error, not a slack-overflow retry loop."""
+    import pytest
+
+    from bigslice_tpu.exec.task import TaskError
+
+    sess = Session(executor=MeshExecutor(mesh))
+
+    def bad(k, nparts):
+        return (k % nparts) + 1  # can yield nparts (out of range)
+
+    rp = bs.Repartition(bs.Const(8, np.arange(64, dtype=np.int32)), bad)
+    with pytest.raises(TaskError, match="outside"):
+        sess.run(rp)
