@@ -21,6 +21,7 @@ from typing import Dict, Iterator, List, Optional, Tuple
 from bigslice_tpu.frame import codec
 from bigslice_tpu.frame.frame import Frame
 from bigslice_tpu.exec.task import TaskName
+from bigslice_tpu.utils import fileio
 
 
 class Missing(KeyError):
@@ -79,13 +80,19 @@ class MemoryStore(Store):
 
 
 class FileStore(Store):
+    """Durable partition store over a path prefix — local directory or
+    any fsspec URL (``gs://bucket/run1``, ``memory://...``; the
+    reference's any-URL fileStore contract, exec/store.go:173-263).
+    Reads stream frame-at-a-time (codec.read_stream), so a spilled
+    multi-GB partition never materializes whole on read-back."""
+
     streaming = True
 
     def __init__(self, prefix: str):
         self.prefix = prefix
 
     def _path(self, name: TaskName, partition: int) -> str:
-        return os.path.join(
+        return fileio.join(
             self.prefix,
             f"inv{name.inv_index}",
             name.op.replace("/", "_"),
@@ -94,28 +101,31 @@ class FileStore(Store):
         )
 
     def put(self, name, partition, frames):
-        path = self._path(name, partition)
-        os.makedirs(os.path.dirname(path), exist_ok=True)
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as fp:
+        with fileio.atomic_write(self._path(name, partition)) as fp:
             for f in frames:
                 fp.write(codec.encode_frame(f))
-        os.replace(tmp, path)
 
     def committed(self, name, partition):
-        return os.path.exists(self._path(name, partition))
+        return fileio.exists(self._path(name, partition))
 
     def read(self, name, partition):
         path = self._path(name, partition)
-        if not os.path.exists(path):
-            raise Missing(f"{name} p{partition}")
-        with open(path, "rb") as fp:
-            data = fp.read()
-        return codec.read_frames(data)
+        try:
+            fp = fileio.open_read(path)
+        except FileNotFoundError as e:
+            # Only true absence maps to Missing (→ DepLost → recompute);
+            # other IO errors (permissions, network) surface as task
+            # errors rather than triggering useless re-evaluation loops.
+            raise Missing(f"{name} p{partition}") from e
+
+        def stream():
+            with fp:
+                yield from codec.read_stream(fp)
+
+        return stream()
 
     def discard(self, name):
-        import shutil
-
-        d = os.path.dirname(self._path(name, 0))
-        if os.path.isdir(d):
-            shutil.rmtree(d, ignore_errors=True)
+        path = self._path(name, 0)
+        d = (path.rsplit("/", 1)[0] if fileio.is_url(path)
+             else os.path.dirname(path))
+        fileio.remove_tree(d)

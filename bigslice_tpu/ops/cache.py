@@ -8,20 +8,20 @@ entire dependency subgraph (deps are dropped at compile time). Cache
 consistency across code changes is the user's responsibility
 (cache.go:36-43).
 
-Files use the checksummed columnar codec (frame/codec.py). Paths may be
-local or any fsspec-style mount; GCS arrives with the file driver.
+Files use the checksummed columnar codec (frame/codec.py). Prefixes may
+be local paths or any fsspec URL (``gs://``, ``s3://``, ``memory://``)
+via utils/fileio — the reference's S3-capable cache contract.
 """
 
 from __future__ import annotations
 
-import os
-import tempfile
 from typing import Optional
 
 from bigslice_tpu import typecheck
 from bigslice_tpu.frame import codec
 from bigslice_tpu import sliceio
 from bigslice_tpu.ops.base import Dep, Slice, make_name
+from bigslice_tpu.utils import fileio
 
 
 def shard_path(prefix: str, shard: int, num_shards: int) -> str:
@@ -49,10 +49,10 @@ class ShardCache:
         file is a legitimately empty shard (its reader yielded no
         frames), not a format mismatch."""
         try:
-            with open(path, "rb") as fp:
+            with fileio.open_read(path) as fp:
                 head = fp.read(4)
                 return head == b"" or head == codec.MAGIC
-        except OSError:
+        except (OSError, FileNotFoundError):
             return False
 
     @property
@@ -63,27 +63,19 @@ class ShardCache:
         return self.present[shard]
 
     def read(self, shard: int):
-        with open(shard_path(self.prefix, shard, self.num_shards), "rb") as fp:
-            data = fp.read()
-        yield from codec.read_frames(data)
+        with fileio.open_read(
+            shard_path(self.prefix, shard, self.num_shards)
+        ) as fp:
+            yield from codec.read_stream(fp)
 
     def writethrough(self, shard: int, reader):
-        """Tee a shard stream into the cache file, atomically."""
+        """Tee a shard stream into the cache file, atomically (local
+        tmp+rename; object-store PUT commit)."""
         path = shard_path(self.prefix, shard, self.num_shards)
-        d = os.path.dirname(path) or "."
-        os.makedirs(d, exist_ok=True)
-        fd, tmp = tempfile.mkstemp(dir=d, prefix=".cache-")
-        ok = False
-        try:
-            with os.fdopen(fd, "wb") as fp:
-                for f in reader:
-                    fp.write(codec.encode_frame(f))
-                    yield f
-            os.replace(tmp, path)
-            ok = True
-        finally:
-            if not ok and os.path.exists(tmp):
-                os.unlink(tmp)
+        with fileio.atomic_write(path) as fp:
+            for f in reader:
+                fp.write(codec.encode_frame(f))
+                yield f
 
 
 class _CachedSlice(Slice):
