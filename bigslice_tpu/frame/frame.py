@@ -254,6 +254,14 @@ class Frame:
         return np.lexsort(tuple(reversed(keys)))
 
     def sorted_by_key(self) -> "Frame":
+        """Stable sort by the key prefix: one jitted ``lax.sort`` on the
+        device for all-scalar-device frames above the dispatch
+        threshold; host lexsort otherwise (object keys, vector payload
+        columns, tiny frames)."""
+        from bigslice_tpu.parallel import sortkernel
+
+        if sortkernel.device_sortable(self):
+            return sortkernel.device_sorted_by_key(self)
         return self.take(self.sort_indices())
 
     # -- row access (tests, scanners, host functions) ---------------------

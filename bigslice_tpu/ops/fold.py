@@ -3,10 +3,15 @@
 Mirrors bigslice.Fold (slice.go:870-955): requires a shuffle dep; each
 shard accumulates ``acc = fn(acc, *values)`` per key and emits
 ``(key, acc)``. Unlike Reduce, the fold function is *not* required to be
-associative, so it cannot be map-side combined (slice.go:885) and runs
-host-tier per shard (the reference's typed accumulator maps, accum.go:20-186,
-become a Python dict here; a device-tier sorted-fold can be layered on for
-traceable fns later).
+associative, so it cannot be map-side combined (slice.go:885).
+
+Two tiers (the reference's typed accumulator maps, accum.go:20-186):
+- **device**: jax-traceable fold fns over scalar-device schemas run the
+  sort + sequential-``lax.scan`` kernel (segment.DeviceSortedFold) —
+  vectorized sort, one fused scan over rows, no per-row Python; also
+  mesh-eligible (the fold becomes an SPMD program stage).
+- **host**: arbitrary fns / mutable accumulators (callable ``init``) /
+  object keys keep the dict loop.
 """
 
 from __future__ import annotations
@@ -59,6 +64,31 @@ class Fold(Slice):
         self.dep_slice = slice_
         self.fn = fn
         self.init = init
+        self.acc_dtype = schema.cols[slice_.prefix].dtype
+        self.device = self._device_eligible()
+
+    def _device_eligible(self) -> bool:
+        """Traceable fold fn + scalar device schema + literal init →
+        the sort+scan kernel serves this fold."""
+        if callable(self.init):
+            return False  # mutable/stateful zero: host semantics
+        in_schema = self.dep_slice.schema
+        out_ct = self.schema.cols[self.prefix]
+        if not all(ct.is_device and ct.shape == ()
+                   for ct in list(in_schema) + [out_ct]):
+            return False
+        try:
+            import jax
+
+            acc_spec = jax.ShapeDtypeStruct((), self.acc_dtype)
+            val_specs = [jax.ShapeDtypeStruct((), ct.dtype)
+                         for ct in in_schema.values]
+            out = jax.eval_shape(self.fn, acc_spec, *val_specs)
+            if isinstance(out, (tuple, list)):
+                return False
+            return out.shape == ()
+        except Exception:
+            return False
 
     def deps(self):
         return (Dep(self.dep_slice, shuffle=True),)
@@ -67,6 +97,30 @@ class Fold(Slice):
         return self.init() if callable(self.init) else self.init
 
     def reader(self, shard, deps):
+        if self.device:
+            return self._read_device(deps)
+        return self._read_host(deps)
+
+    def _read_device(self, deps):
+        def read():
+            from bigslice_tpu.parallel import segment
+
+            frame = sliceio.read_all(deps[0](), self.dep_slice.schema)
+            if not len(frame):
+                return
+            host = frame.to_host()
+            nk = self.prefix
+            kern = segment.cached_sorted_fold(
+                self.fn, nk, len(self.dep_slice.schema) - nk,
+                self.init, self.acc_dtype,
+            )
+            keys, accs = kern(list(host.key_cols()),
+                              list(host.value_cols()), len(host))
+            yield Frame(list(keys) + list(accs), self.schema)
+
+        return read()
+
+    def _read_host(self, deps):
         def read():
             acc = {}
             order = []

@@ -7,8 +7,10 @@ checksummed columnar codec, then the runs are streamed back through a
 k-way merge.
 
 TPU-first split of responsibilities:
-- *in-run sorting* happens on device (``lax.sort`` via Frame.sorted_by_key
-  for device columns — the reference sorts with reflection comparators);
+- *in-run sorting*: all-scalar-device runs sort on device — one jitted
+  stable ``lax.sort`` per run (parallel/sortkernel via
+  Frame.sorted_by_key); object-keyed or vector-column runs use host
+  lexsort (the reference sorts everything with reflection comparators);
 - *spill and merge* are host-tier (disk + heap merge), exactly the part
   that must not live in HBM.
 

@@ -84,3 +84,89 @@ def test_canonical_combine_multi():
     assert cfn((1, 2), (3, 4)) == (4, 8)
     cfn1 = segment.canonical_combine(lambda a, b: a + b, 1)
     assert cfn1((5,), (6,)) == (11,)
+
+
+class TestDeviceFold:
+    def _oracle(self, keys, vals, fn, init):
+        acc = {}
+        for k, v in zip(keys.tolist(), vals.tolist()):
+            acc[k] = fn(acc.get(k, init), v)
+        return acc
+
+    def test_sorted_fold_matches_dict_oracle(self):
+        from bigslice_tpu.parallel import segment
+
+        rng = np.random.RandomState(5)
+        keys = rng.randint(0, 20, 500).astype(np.int32)
+        vals = rng.randint(1, 6, 500).astype(np.int32)
+        # Non-associative fold: acc*2 + v (order-sensitive).
+        kern = segment.DeviceSortedFold(
+            lambda acc, v: acc * 2 + v, 1, 1, 0, np.dtype(np.int32)
+        )
+        (k_out,), (a_out,) = kern([keys], [vals], len(keys))
+        oracle = self._oracle(keys, vals, lambda a, v: a * 2 + v, 0)
+        got = dict(zip(k_out.tolist(), a_out.tolist()))
+        # int32 overflow wraps identically in numpy and jax; compare mod 2^32
+        assert got.keys() == oracle.keys()
+        for k in got:
+            assert got[k] == np.int32(oracle[k] & 0xFFFFFFFF).item() or \
+                got[k] == np.int32(oracle[k]).item()
+
+    def test_fold_slice_device_tier(self):
+        """Fold over a traceable fn classifies device and matches the
+        host dict tier."""
+        import bigslice_tpu as bs
+        from bigslice_tpu.exec.session import Session
+
+        keys = (np.arange(120, dtype=np.int32) * 7) % 10
+        vals = np.arange(120, dtype=np.float32)
+
+        def fmax(acc, v):
+            import jax.numpy as jnp
+
+            return jnp.maximum(acc, v)
+
+        f = bs.Fold(bs.Const(4, keys, vals), fmax, init=-1.0,
+                    out_value=np.float32)
+        assert f.device
+        got = dict(Session().run(f).rows())
+        oracle = {}
+        for k, v in zip(keys.tolist(), vals.tolist()):
+            oracle[k] = max(oracle.get(k, -1.0), v)
+        assert got == oracle
+
+    def test_fold_host_tier_for_callable_init(self):
+        import bigslice_tpu as bs
+        from bigslice_tpu.exec.session import Session
+
+        keys = np.arange(20, dtype=np.int32) % 3
+        vals = np.ones(20, np.int32)
+        f = bs.Fold(bs.Const(2, keys, vals),
+                    lambda acc, v: acc + [v], init=list,
+                    out_value=bs.ColType(np.dtype(object), tag="list"))
+        assert not f.device
+        got = dict(Session().run(f).rows())
+        assert {k: len(v) for k, v in got.items()} == {0: 7, 1: 7, 2: 6}
+
+    def test_fold_on_mesh(self):
+        """Device fold runs as an SPMD stage on the mesh executor."""
+        import jax
+
+        import bigslice_tpu as bs
+        from jax.sharding import Mesh
+        from bigslice_tpu.exec.meshexec import MeshExecutor
+        from bigslice_tpu.exec.session import Session
+
+        mesh = Mesh(np.array(jax.devices()[:8]), ("shards",))
+        sess = Session(executor=MeshExecutor(mesh))
+        keys = (np.arange(160, dtype=np.int32) * 3) % 12
+        vals = np.ones(160, np.int32)
+        f = bs.Fold(bs.Const(8, keys, vals), lambda acc, v: acc + v,
+                    init=0, out_value=np.int32)
+        assert f.device
+        got = dict(sess.run(f).rows())
+        oracle = {}
+        for k in keys.tolist():
+            oracle[k] = oracle.get(k, 0) + 1
+        assert got == oracle
+        assert sess.executor.device_group_count() >= 2

@@ -112,3 +112,49 @@ def test_cogroup_large_spilling(tmp_path, monkeypatch):
     for k, grouped in rows:
         assert sorted(grouped) == sorted(oracle[k])
     assert spills  # the disk path actually ran
+
+
+def test_device_run_sort_matches_lexsort():
+    """The device lax.sort run path and the host lexsort path produce
+    identical orderings (stable, multi-key)."""
+    from bigslice_tpu.frame.frame import Frame
+    from bigslice_tpu.parallel import sortkernel
+    from bigslice_tpu.slicetype import Schema
+
+    rng = np.random.RandomState(3)
+    n = sortkernel.DEVICE_SORT_MIN_ROWS + 17
+    k1 = rng.randint(0, 50, n).astype(np.int32)
+    k2 = rng.randint(0, 7, n).astype(np.int32)
+    v = np.arange(n, dtype=np.int32)
+    f = Frame([k1, k2, v], Schema([np.int32] * 3, prefix=2))
+    assert sortkernel.device_sortable(f)
+    dev = sortkernel.device_sorted_by_key(f)
+    host = f.take(f.sort_indices())
+    for a, b in zip(dev.cols, host.cols):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_sorted_by_key_dispatches_to_device(monkeypatch):
+    from bigslice_tpu.frame.frame import Frame
+    from bigslice_tpu.parallel import sortkernel
+    from bigslice_tpu.slicetype import Schema
+
+    called = []
+    orig = sortkernel.device_sorted_by_key
+    monkeypatch.setattr(
+        sortkernel, "device_sorted_by_key",
+        lambda fr: called.append(1) or orig(fr),
+    )
+    n = sortkernel.DEVICE_SORT_MIN_ROWS
+    f = Frame([np.arange(n, dtype=np.int32)[::-1].copy()],
+              Schema([np.int32], prefix=1))
+    out = f.sorted_by_key()
+    assert called and np.asarray(out.cols[0]).tolist() == list(range(n))
+    # Object keys stay on the host path.
+    called.clear()
+    from bigslice_tpu.frame.frame import obj_col
+
+    g = Frame([obj_col([f"w{i}" for i in range(n)])],
+              Schema([str], prefix=1))
+    g.sorted_by_key()
+    assert not called
