@@ -11,12 +11,21 @@ reachable task to OK —
 - multiple concurrent evaluations of overlapping graphs coordinate purely
   through task state (exec/eval.go:126-135) — an eval that sees a task
   RUNNING simply waits for its transition.
+
+Scheduling is *event-driven with dependency counting* (the reference's
+per-phase waitlist idea, exec/eval.go:255-347, adapted): each task
+carries a pending-dependency count maintained from state-transition
+events; a task whose count reaches zero while INIT/LOST is submitted.
+Cost per transition is O(consumers of that task) — no full-graph rescan
+and no fixed-interval polling on the hot path (a coarse safety sweep
+guards against executor bugs that would otherwise hang forever).
 """
 
 from __future__ import annotations
 
+import collections
 import threading
-from typing import Optional, Sequence
+from typing import Dict, List, Optional, Sequence
 
 from bigslice_tpu.exec.task import (
     Task,
@@ -27,6 +36,11 @@ from bigslice_tpu.exec.task import (
 
 MAX_CONSECUTIVE_LOST = 5  # exec/eval.go:30
 
+# Safety-net sweep interval: the event-driven loop needs no polling, but
+# a lost wakeup (executor dropping a task without a transition) must
+# fail loudly rather than hang. Coarse on purpose.
+SWEEP_SECS = 5.0
+
 
 def evaluate(executor, roots: Sequence[Task], monitor=None) -> None:
     """Evaluate the graph rooted at ``roots`` to completion.
@@ -35,100 +49,186 @@ def evaluate(executor, roots: Sequence[Task], monitor=None) -> None:
     task from WAITING to a terminal state). ``monitor``, if given, receives
     ``(task, state)`` transition callbacks (status displays, tracing).
     """
-    tasks = iter_tasks(roots)
-    cond = threading.Condition()
-
-    def wake(task: Task, state: TaskState) -> None:
-        if monitor is not None:
-            monitor(task, state)
-        with cond:
-            cond.notify_all()
-
-    for t in tasks:
-        t.subscribe(wake)
-    try:
-        _loop(executor, roots, tasks, cond)
-    finally:
-        for t in tasks:
-            t.unsubscribe(wake)
+    _Evaluation(executor, roots, monitor).run()
 
 
-def _loop(executor, roots, tasks, cond) -> None:
-    while True:
-        # Terminal checks.
-        states = {id(t): t.state for t in tasks}
-        if any(states[id(t)] == TaskState.ERR for t in tasks):
-            # Let in-flight tasks settle, then surface the first error.
-            bad = next(t for t in tasks if t.state == TaskState.ERR)
-            _drain(tasks, cond)
-            raise TaskError(bad, bad.error or RuntimeError("task error"))
-        if all(states[id(r)] == TaskState.OK for r in roots):
-            return
+class _Evaluation:
+    def __init__(self, executor, roots, monitor):
+        self.executor = executor
+        self.roots = list(roots)
+        self.monitor = monitor
+        self.tasks = iter_tasks(roots)
+        self.cond = threading.Condition()
+        self.events: collections.deque = collections.deque()
+        # Reverse edges + pending-dep counts (the waitlist core).
+        self.consumers: Dict[int, List[Task]] = {
+            id(t): [] for t in self.tasks
+        }
+        self.dep_counts: Dict[int, int] = {}
+        self.ok_seen: set = set()  # dep ids currently credited as OK
 
-        progressed = False
-        for t in tasks:
-            st = t.state
-            if st not in (TaskState.INIT, TaskState.LOST):
-                continue
-            # A task whose result has been lost must wait for its deps to
-            # be re-evaluated; deps appear earlier in post-order, so
-            # they're submitted in this same pass.
-            if not all(
+    def _wake(self, task: Task, state: TaskState) -> None:
+        if self.monitor is not None:
+            self.monitor(task, state)
+        with self.cond:
+            self.events.append((task, state))
+            self.cond.notify_all()
+
+    def run(self) -> None:
+        for t in self.tasks:
+            t.subscribe(self._wake)
+        try:
+            self._run()
+        finally:
+            for t in self.tasks:
+                t.unsubscribe(self._wake)
+
+    # -- graph bookkeeping -------------------------------------------------
+
+    def _build(self) -> List[Task]:
+        """Initial pending counts from a one-read-per-task state
+        snapshot; returns the initially submittable set.
+
+        The snapshot is taken AFTER subscribing: transitions before it
+        are reflected in the snapshot, transitions after it arrive as
+        ordered events, and the ok_seen gating keeps the replay
+        consistent with the snapshot (each task's state is read exactly
+        once, so no two consumers account the same dep differently)."""
+        snapshot = {id(t): t.state for t in self.tasks}
+        for t in self.tasks:
+            if snapshot[id(t)] == TaskState.OK:
+                self.ok_seen.add(id(t))
+        ready = []
+        for t in self.tasks:
+            deps = t.all_dep_tasks()
+            pending = 0
+            for d in deps:
+                self.consumers[id(d)].append(t)
+                if snapshot[id(d)] != TaskState.OK:
+                    pending += 1
+            self.dep_counts[id(t)] = pending
+            if pending == 0 and snapshot[id(t)] in (TaskState.INIT,
+                                                    TaskState.LOST):
+                ready.append(t)
+        return ready
+
+    def _on_event(self, task: Task, state: TaskState,
+                  ready: List[Task]) -> Optional[Task]:
+        """Update counts for one transition; append newly submittable
+        tasks to ``ready``. Returns an ERR task if one surfaced."""
+        tid = id(task)
+        if state == TaskState.OK:
+            if tid not in self.ok_seen:
+                self.ok_seen.add(tid)
+                for c in self.consumers.get(tid, ()):
+                    cid = id(c)
+                    self.dep_counts[cid] -= 1
+                    if self.dep_counts[cid] == 0 and c.state in (
+                        TaskState.INIT, TaskState.LOST
+                    ):
+                        ready.append(c)
+        elif state == TaskState.LOST:
+            if tid in self.ok_seen:
+                # A previously-OK dep was lost: re-charge consumers.
+                self.ok_seen.discard(tid)
+                for c in self.consumers.get(tid, ()):
+                    self.dep_counts[id(c)] += 1
+            if self.dep_counts.get(tid, 1) == 0:
+                ready.append(task)
+        elif state == TaskState.ERR:
+            return task
+        return None
+
+    def _submit(self, task: Task) -> bool:
+        """Submit if still runnable; enforce the consecutive-loss cap."""
+        st = task.state
+        if st not in (TaskState.INIT, TaskState.LOST):
+            return False
+        if task.consecutive_lost >= MAX_CONSECUTIVE_LOST:
+            task.set_state(
+                TaskState.ERR,
+                RuntimeError(
+                    f"task {task.name} lost {task.consecutive_lost} "
+                    f"consecutive times"
+                ),
+            )
+            return False
+        if task.transition_if(st, TaskState.WAITING):
+            self.executor.submit(task)
+            return True
+        return False
+
+    # -- the loop ----------------------------------------------------------
+
+    def _run(self) -> None:
+        with self.cond:
+            ready = self._build()
+        # A task already fatal when evaluation starts (e.g. failed under
+        # a concurrent evaluation) surfaces immediately — no transition
+        # event will ever fire for it.
+        err_task = next(
+            (t for t in self.tasks if t.state == TaskState.ERR), None
+        )
+        while True:
+            # Submit outside the lock (executors may call back inline).
+            for t in ready:
+                self._submit(t)
+            ready = []
+            with self.cond:
+                while not self.events:
+                    if all(r.state == TaskState.OK for r in self.roots):
+                        return
+                    if err_task is not None:
+                        break
+                    if not self.cond.wait(timeout=SWEEP_SECS):
+                        self._sweep(ready)
+                        if ready:
+                            break
+                while self.events:
+                    task, state = self.events.popleft()
+                    bad = self._on_event(task, state, ready)
+                    if bad is not None and err_task is None:
+                        err_task = bad
+            if err_task is not None:
+                self._drain()
+                raise TaskError(
+                    err_task, err_task.error or RuntimeError("task error")
+                )
+
+    def _sweep(self, ready: List[Task]) -> None:
+        """Safety net: after a quiet interval, re-derive submittable
+        tasks from scratch and fail loudly on a true stall (a cycle or
+        an executor that dropped a task silently)."""
+        for t in self.tasks:
+            if t.state in (TaskState.INIT, TaskState.LOST) and all(
                 d.state == TaskState.OK for d in t.all_dep_tasks()
             ):
-                continue
-            if t.consecutive_lost >= MAX_CONSECUTIVE_LOST:
-                t.set_state(
-                    TaskState.ERR,
-                    RuntimeError(
-                        f"task {t.name} lost {t.consecutive_lost} "
-                        f"consecutive times"
-                    ),
-                )
-                progressed = True
-                break
-            if t.transition_if(st, TaskState.WAITING):
-                executor.submit(t)
-                progressed = True
-        if progressed:
-            continue
-        # Nothing to submit: either work is in flight, or we're waiting on
-        # another evaluation driving shared tasks.
-        in_flight = any(
-            t.state in (TaskState.WAITING, TaskState.RUNNING) for t in tasks
-        )
-        with cond:
-            if in_flight or _dirty(tasks, roots):
-                cond.wait(timeout=0.2)
-            else:
-                # No running tasks, roots not OK, nothing runnable: a
-                # cycle or an executor that dropped a task. Should be
-                # impossible; fail loudly rather than hang.
-                if all(t.state == TaskState.OK for t in roots):
-                    return
-                raise RuntimeError(
-                    "evaluation stalled: no runnable or running tasks"
-                )
-
-
-def _dirty(tasks, roots) -> bool:
-    """Re-check for actionable state that raced with our scan."""
-    if all(r.state == TaskState.OK for r in roots):
-        return True
-    for t in tasks:
-        if t.state in (TaskState.INIT, TaskState.LOST, TaskState.ERR):
-            return True
-    return False
-
-
-def _drain(tasks, cond, timeout: float = 30.0) -> None:
-    import time
-
-    deadline = time.time() + timeout
-    while time.time() < deadline:
-        if not any(
-            t.state in (TaskState.WAITING, TaskState.RUNNING) for t in tasks
-        ):
+                ready.append(t)
+        if ready:
             return
-        with cond:
-            cond.wait(timeout=0.2)
+        in_flight = any(
+            t.state in (TaskState.WAITING, TaskState.RUNNING)
+            for t in self.tasks
+        )
+        if in_flight:
+            return
+        if all(r.state == TaskState.OK for r in self.roots):
+            return
+        if any(t.state == TaskState.ERR for t in self.tasks):
+            return  # the event loop will surface it
+        raise RuntimeError(
+            "evaluation stalled: no runnable or running tasks"
+        )
+
+    def _drain(self, timeout: float = 30.0) -> None:
+        import time
+
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if not any(
+                t.state in (TaskState.WAITING, TaskState.RUNNING)
+                for t in self.tasks
+            ):
+                return
+            with self.cond:
+                self.cond.wait(timeout=0.2)

@@ -14,6 +14,7 @@ SPMD path is the mesh executor (exec/meshexec.py).
 from __future__ import annotations
 
 import os
+import queue
 import threading
 from typing import List, Optional
 
@@ -95,11 +96,24 @@ class _Limiter:
 class LocalExecutor:
     name = "local"
 
+    # Workers exit after this long idle (cached-pool semantics: bursty
+    # sessions reuse threads; quiet executors shed them).
+    WORKER_IDLE_SECS = 10.0
+
     def __init__(self, procs: Optional[int] = None,
                  store: Optional[store_mod.Store] = None):
         self.procs = procs or os.cpu_count() or 4
         self._limiter = _Limiter(self.procs)
         self.store = store or store_mod.MemoryStore()
+        # Bounded worker pool (the exec/local.go:50-56 goroutine+limiter
+        # role without one OS thread per submitted task): at most
+        # ``procs`` workers, created on demand, reaped when idle. Tasks
+        # must not synchronously evaluate other slices inside their body
+        # (same finite-procs property as the reference's workers).
+        self._queue: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._pool_lock = threading.Lock()
+        self._workers = 0
+        self._idle = 0
         # Machine (process) level shared combiners (MachineCombiners):
         # per combine key, the partitioned contributions of each producer
         # shard; combined once when the last shard lands (the worker-side
@@ -115,7 +129,36 @@ class LocalExecutor:
     # -- evaluation-facing API (Executor iface, exec/eval.go:42-71) -------
 
     def submit(self, task: Task) -> None:
-        threading.Thread(target=self._run, args=(task,), daemon=True).start()
+        self._queue.put(task)
+        with self._pool_lock:
+            if self._idle == 0 and self._workers < self.procs:
+                self._workers += 1
+                threading.Thread(target=self._worker_loop,
+                                 daemon=True).start()
+
+    def _worker_loop(self) -> None:
+        while True:
+            with self._pool_lock:
+                self._idle += 1
+            try:
+                task = self._queue.get(timeout=self.WORKER_IDLE_SECS)
+            except queue.Empty:
+                # Exit-vs-submit race: a task enqueued after the timeout
+                # fired but while this worker still counted as idle (so
+                # submit spawned no replacement) must not strand —
+                # re-check the queue under the pool lock before leaving.
+                with self._pool_lock:
+                    self._idle -= 1
+                    try:
+                        task = self._queue.get_nowait()
+                    except queue.Empty:
+                        self._workers -= 1
+                        return
+                self._run(task)
+                continue
+            with self._pool_lock:
+                self._idle -= 1
+            self._run(task)
 
     def reader(self, task: Task, partition: int) -> sliceio.Reader:
         return self.store.read(task.name, partition)

@@ -206,20 +206,29 @@ class Task:
 
 
 def iter_tasks(roots: Sequence[Task]):
-    """Post-order DFS over the task graph, each task once
-    (mirrors iterTasks, exec/slicestatus.go:50-81)."""
+    """Post-order DFS over the task graph, each task once (mirrors
+    iterTasks, exec/slicestatus.go:50-81). Iterative: deep pipelines
+    (10k+ chained tasks) must not hit the Python recursion limit."""
     seen = set()
     out: List[Task] = []
-
-    def walk(t: Task):
-        if id(t) in seen:
-            return
-        seen.add(id(t))
-        for dep in t.deps:
-            for d in dep.tasks:
-                walk(d)
-        out.append(t)
-
     for r in roots:
-        walk(r)
+        if id(r) in seen:
+            continue
+        stack: List[Tuple[Task, bool]] = [(r, False)]
+        while stack:
+            t, expanded = stack.pop()
+            if expanded:
+                out.append(t)
+                continue
+            if id(t) in seen:
+                continue
+            seen.add(id(t))
+            stack.append((t, True))
+            # Reversed so dependency visit order matches the recursive
+            # form (first dep first in post-order).
+            for dep in reversed(t.deps):
+                for d in reversed(dep.tasks):
+                    if id(d) not in seen:
+                        stack.append((d, False))
+        # r handled by the stack walk.
     return out
