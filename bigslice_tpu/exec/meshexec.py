@@ -70,6 +70,30 @@ class DeviceGroupOutput:
         self._chunks = None
         self._chunks_lock = threading.Lock()
 
+    def gather(self) -> None:
+        """Cross-process collective gather of the output to host, called
+        eagerly (in deterministic launch order) by the SPMD dispatcher —
+        host_chunks() must never run a collective lazily, since lazy
+        reads happen in nondeterministic thread order across processes."""
+        with self._chunks_lock:
+            if self._chunks is not None:
+                return
+            from jax.experimental import multihost_utils
+
+            cols = [
+                np.asarray(
+                    multihost_utils.process_allgather(c, tiled=True)
+                )
+                for c in self.cols
+            ]
+            counts = np.asarray(
+                multihost_utils.process_allgather(self.counts,
+                                                  tiled=True)
+            )
+            self._chunks = shuffle_mod.unshard_columns(
+                cols, counts, self.capacity
+            )
+
     def host_chunks(self) -> List[List[np.ndarray]]:
         # Memoized: every (task, partition) read would otherwise pull the
         # whole global output device→host again.
@@ -116,9 +140,19 @@ class MeshExecutor:
     name = "mesh"
 
     def __init__(self, mesh, fallback_procs: Optional[int] = None,
-                 ordered_dispatch: bool = False):
+                 ordered_dispatch: bool = False, spmd: bool = False):
         self.mesh = mesh
         self.nmesh = int(mesh.devices.size)
+        # SPMD session mode: this executor is one of N identical
+        # processes forming a global mesh (every process runs the same
+        # driver program — SURVEY.md §7.1's Func-registry-by-
+        # construction). Forces ordered dispatch; group launch decisions
+        # are pure functions of deterministic task state (no wall-clock
+        # skips), and group outputs gather to every host eagerly in
+        # launch order so no collective ever runs lazily. One driver
+        # thread per process: no concurrent sess.run in this mode.
+        self.spmd = spmd
+        self.multiprocess = shuffle_mod.is_multiprocess_mesh(mesh)
         self.store = _BridgedStore(self)
         self.local = LocalExecutor(procs=fallback_procs, store=self.store)
         self._lock = threading.Lock()
@@ -131,11 +165,15 @@ class MeshExecutor:
         # (deterministic by construction — the issue-order discipline
         # SPMD multi-host sessions need: every process must enter jitted
         # collectives in the same order). Groups that route to the
-        # fallback path, or never materialize (already satisfied by a
-        # prior run), are cancelled/skipped from the plan.
-        self.ordered_dispatch = ordered_dispatch
+        # fallback path are cancelled; groups partially satisfied by a
+        # prior run launch when every member is accounted for
+        # (submitted or already OK) — a state-driven decision, not a
+        # timed one.
+        self.ordered_dispatch = ordered_dispatch or spmd
         self._plan: List[Tuple] = []
         self._plan_set: set = set()  # mirrors _plan membership
+        self._plan_members: Dict[Tuple, Tuple[Task, ...]] = {}
+        self._plan_token: Dict[Tuple, object] = {}
         self._ready_set: set = set()
         self._cancelled: set = set()
         self._ready_cond = threading.Condition(self._lock)
@@ -147,23 +185,63 @@ class MeshExecutor:
 
     # -- Executor interface ----------------------------------------------
 
-    def plan_groups(self, keys) -> None:
+    def plan_groups(self, entries, token=None) -> None:
         """Register the deterministic launch order for upcoming device
         groups (called by the session before evaluation when
-        ordered_dispatch is on)."""
+        ordered_dispatch is on). ``entries`` is an ordered sequence of
+        ``(group_key, member_tasks)``; groups whose members are all
+        already OK are omitted by the caller (nothing to launch).
+        ``token`` identifies the run, so finish_run(token) can clear
+        exactly this run's leftovers."""
         if not self.ordered_dispatch:
             return
         with self._lock:
-            for k in keys:
+            for k, members in entries:
                 if k is not None and k not in self._plan_set:
                     self._plan.append(k)
                     self._plan_set.add(k)
+                    self._plan_members[k] = tuple(members)
+                    self._plan_token[k] = token
             if self._dispatcher is None:
                 self._dispatcher = threading.Thread(
                     target=self._dispatch_loop, daemon=True
                 )
                 self._dispatcher.start()
             self._ready_cond.notify_all()
+
+    def finish_run(self, token=None) -> None:
+        """Called by the session when an evaluation completes (success
+        or error): this run's remaining plan entries will never receive
+        further submissions (group keys are per-compilation), so drop
+        them — and flush any partially-arrived group's parked tasks to
+        the fallback so they still settle — rather than wedging the
+        dispatcher (and every later run queued behind) forever.
+        Deterministic across SPMD processes, as evaluation outcomes
+        are."""
+        if not self.ordered_dispatch:
+            return
+        flush = []
+        with self._lock:
+            keep = []
+            for k in self._plan:
+                if self._plan_token.get(k) != token:
+                    keep.append(k)  # another run's entry
+                    continue
+                g = self._groups.get(k)
+                if g is not None and not g.launched:
+                    g.launched = True
+                    if g.timer:
+                        g.timer.cancel()
+                    del self._groups[k]
+                    flush.extend(g.tasks.values())
+                self._plan_set.discard(k)
+                self._plan_members.pop(k, None)
+                self._plan_token.pop(k, None)
+                self._cancelled.discard(k)
+            self._plan = keep
+            self._ready_cond.notify_all()
+        for t in flush:
+            self.local.submit(t)
 
     def submit(self, task: Task) -> None:
         if not self._eligible(task):
@@ -205,12 +283,20 @@ class MeshExecutor:
                     if planned:
                         self._ready_set.add(key)
                         self._ready_cond.notify_all()
-            elif g.timer is None and not g.launched:
+            elif (g.timer is None and not g.launched
+                  and not self.ordered_dispatch):
+                # Unordered mode only: the straggler watchdog. Ordered
+                # dispatch resolves partial groups from plan membership
+                # (state-driven, cross-process safe), never from timers.
                 g.timer = threading.Timer(
                     GROUP_WAIT_SECS, self._flush_stragglers, (key,)
                 )
                 g.timer.daemon = True
                 g.timer.start()
+            if self.ordered_dispatch:
+                # Wake the dispatcher: a new arrival may complete the
+                # plan head's membership accounting.
+                self._ready_cond.notify_all()
         if complete and not planned:
             threading.Thread(
                 target=self._run_group, args=(key,), daemon=True
@@ -268,6 +354,7 @@ class MeshExecutor:
             if len(dep.tasks) > self.nmesh:
                 return False
         from bigslice_tpu.ops.const import Const
+        from bigslice_tpu.ops.fold import Fold
         from bigslice_tpu.ops.join import JoinAggregate
         from bigslice_tpu.ops.mapops import (
             Filter,
@@ -297,6 +384,10 @@ class MeshExecutor:
                 if not s.frame_combiner.device:
                     return False
                 continue
+            if isinstance(s, Fold):
+                if not s.device:
+                    return False
+                continue
             if isinstance(s, JoinAggregate):
                 # Two-input stage: only as the chain's innermost (it
                 # consumes the raw dep inputs); both sides' combine fns
@@ -318,42 +409,66 @@ class MeshExecutor:
     def _dispatch_loop(self) -> None:
         while True:
             key = None
+            members = None
             with self._lock:
                 while True:
                     while not self._plan:
                         self._ready_cond.wait()
                     head = self._plan[0]
                     if head in self._cancelled:
-                        self._plan.pop(0)
-                        self._plan_set.discard(head)
+                        self._pop_head(head)
                         self._cancelled.discard(head)
                         continue
                     if head in self._ready_set:
-                        self._plan.pop(0)
-                        self._plan_set.discard(head)
+                        self._pop_head(head)
                         self._ready_set.discard(head)
                         key = head
                         break
-                    # Head not ready yet. It may never arrive (all its
-                    # tasks satisfied by a prior run): after a grace
-                    # period with no sign of it, skip — such groups run
-                    # no collectives on any process, so skipping is
-                    # cross-process consistent. If its tasks show up
-                    # later anyway (slow fallback deps), submit() sees
-                    # the key gone from the plan and dispatches the
-                    # group directly rather than parking it.
-                    if not self._ready_cond.wait(timeout=GROUP_WAIT_SECS):
-                        if (head not in self._ready_set
-                                and head not in self._groups):
-                            self._plan.pop(0)
-                            self._plan_set.discard(head)
-                            self._cancelled.discard(head)
+                    if head not in self._plan_members:
+                        # Defensive: unplanned key (shouldn't happen).
+                        self._pop_head(head)
+                        continue
+                    # Membership-driven completion (no wall-clock
+                    # decisions — cross-process deterministic): the head
+                    # launches once every member is accounted for,
+                    # either submitted to us or already OK from a prior
+                    # run. The timed wait below only re-polls state; it
+                    # never decides anything.
+                    g = self._groups.get(head)
+                    arrived = g.tasks if g is not None else {}
+                    pending = [
+                        t for t in self._plan_members.get(head, ())
+                        if t.name.shard not in arrived
+                        and t.state != TaskState.OK
+                    ]
+                    if not pending:
+                        full = self._plan_members.get(head, ())
+                        self._pop_head(head)
+                        if g is not None and arrived and not g.launched:
+                            g.launched = True
+                            if g.timer:
+                                g.timer.cancel()
+                            del self._groups[head]
+                            key = head
+                            members = (full, dict(arrived))
+                            break
+                        continue  # fully satisfied: nothing to launch
+                    self._ready_cond.wait(timeout=0.05)
             try:
-                self._run_group(key)
+                if members is not None:
+                    self._run_group(key, prepopped=members)
+                else:
+                    self._run_group(key)
             except Exception:  # noqa: BLE001 — keep the dispatcher alive
                 # _run_group reports task state itself; a raise here
                 # must not kill the only dispatcher.
                 pass
+
+    def _pop_head(self, head) -> None:
+        self._plan.pop(0)
+        self._plan_set.discard(head)
+        self._plan_members.pop(head, None)
+        self._plan_token.pop(head, None)
 
     def _flush_stragglers(self, key) -> None:
         with self._lock:
@@ -369,15 +484,25 @@ class MeshExecutor:
         for t in tasks:
             self.local.submit(t)
 
-    def _run_group(self, key) -> None:
-        with self._lock:
-            g = self._groups.pop(key)
-        tasks = [g.tasks[s] for s in range(g.num_shard)]
+    def _run_group(self, key, prepopped=None) -> None:
+        if prepopped is None:
+            with self._lock:
+                g = self._groups.pop(key)
+            tasks = [g.tasks[s] for s in range(g.num_shard)]
+            to_claim = tasks
+        else:
+            # Partially-arrived group from the ordered dispatcher: the
+            # SPMD program spans every shard; only the non-OK members
+            # are claimed/re-marked (already-OK siblings keep their
+            # state, their outputs are recomputed identically).
+            full, arrived = prepopped
+            tasks = sorted(full, key=lambda t: t.name.shard)
+            to_claim = [arrived[s] for s in sorted(arrived)]
         claimed = []
-        for t in tasks:
+        for t in to_claim:
             if t.transition_if(TaskState.WAITING, TaskState.RUNNING):
                 claimed.append(t)
-        if len(claimed) != len(tasks):
+        if len(claimed) != len(to_claim):
             # Another evaluation claimed part of the group: release ours
             # back to the fallback path.
             for t in claimed:
@@ -389,15 +514,20 @@ class MeshExecutor:
             with self._lock:
                 for t in tasks:
                     self._task_index[t.name] = (key, t)
-            for t in tasks:
+                out = self._outputs.get(key)
+            if self.multiprocess and out is not None:
+                # Eager cross-process gather in launch order (see
+                # DeviceGroupOutput.gather).
+                out.gather()
+            for t in claimed:
                 t.mark_ok()
         except DepLost as e:
             for p in e.producers:
                 p.mark_lost(e)
-            for t in tasks:
+            for t in claimed:
                 t.mark_lost(e)
         except Exception as e:  # noqa: BLE001
-            for t in tasks:
+            for t in claimed:
                 t.set_state(TaskState.ERR, e)
 
     # -- the SPMD program --------------------------------------------------
@@ -539,6 +669,7 @@ class MeshExecutor:
     def _stages_for(self, task: Task) -> List[tuple]:
         """Flatten the chain (innermost→outermost) + output partitioner
         into device stage descriptors (kind, struct_id, slice)."""
+        from bigslice_tpu.ops.fold import Fold
         from bigslice_tpu.ops.join import JoinAggregate
         from bigslice_tpu.ops.mapops import Filter, Flatmap, Head, Map
         from bigslice_tpu.ops.reduce import Reduce
@@ -557,6 +688,13 @@ class MeshExecutor:
                 fc = s.frame_combiner
                 stages.append(("combine", (id(fc.fn), fc.nkeys, fc.nvals),
                                s))
+            elif isinstance(s, Fold):
+                stages.append((
+                    "fold",
+                    (id(s.fn), s.prefix, repr(s.init),
+                     str(s.acc_dtype)),
+                    s,
+                ))
             elif isinstance(s, JoinAggregate):
                 fa, fb = s.frame_combiners
                 stages.append((
@@ -712,6 +850,15 @@ class MeshExecutor:
                         tuple(cols[fc.nkeys :]),
                     )
                     cols = list(keys) + list(vals)
+                elif kind == "fold":
+                    nk = s.prefix
+                    core = segment.make_sequential_fold_masked(
+                        nk, len(cols) - nk, s.fn, s.init, s.acc_dtype
+                    )
+                    mask, keys, accs = core(
+                        mask, tuple(cols[:nk]), tuple(cols[nk:])
+                    )
+                    cols = list(keys) + list(accs)
                 else:  # shuffle
                     part = s.partitioner
                     fc = part.combiner
@@ -782,7 +929,7 @@ class MeshExecutor:
         stage order (cache-validation identities)."""
         fns = []
         for kind, _, s in stages:
-            if kind in ("map", "flatmap"):
+            if kind in ("map", "flatmap", "fold"):
                 fns.append(s.fn)
             elif kind == "filter":
                 fns.append(s.pred)

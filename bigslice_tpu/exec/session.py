@@ -224,17 +224,31 @@ class Session:
         ).compile(slice_)
         if self.debug is not None:
             self.debug.register_roots(tasks)
+        run_token = None
         plan_groups = getattr(self.executor, "plan_groups", None)
         if plan_groups is not None:
-            from bigslice_tpu.exec.task import iter_tasks
+            from bigslice_tpu.exec.task import TaskState, iter_tasks
 
             # Post-order DFS is deterministic given the same program —
             # the ordered dispatcher's cross-process launch sequence.
-            seen = []
+            # Groups whose members are all already OK (Result reuse)
+            # are omitted: nothing of theirs will launch.
+            groups: Dict[Any, list] = {}
+            order = []
             for t in iter_tasks(tasks):
-                if t.group_key is not None and t.group_key not in seen:
-                    seen.append(t.group_key)
-            plan_groups(seen)
+                if t.group_key is None:
+                    continue
+                if t.group_key not in groups:
+                    groups[t.group_key] = []
+                    order.append(t.group_key)
+                groups[t.group_key].append(t)
+            run_token = object()  # collision-free per-run identity
+            plan_groups(
+                ((k, groups[k]) for k in order
+                 if not all(m.state == TaskState.OK
+                            for m in groups[k])),
+                token=run_token,
+            )
         # Exclusive invocations evaluate in isolation from concurrent
         # runs of this session; their own shards stay parallel.
         self._gate.acquire(exclusive)
@@ -242,6 +256,9 @@ class Session:
             evaluate(self.executor, tasks, monitor=self.monitor)
         finally:
             self._gate.release(exclusive)
+            finish = getattr(self.executor, "finish_run", None)
+            if finish is not None:
+                finish(token=run_token)
         return Result(self, slice_, tasks)
 
     # Go-flavored alias (Session.Must): raise on error is Python's default.

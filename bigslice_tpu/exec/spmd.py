@@ -1,0 +1,46 @@
+"""SPMD sessions: the multi-host distributed session model.
+
+The reference runs sessions over ad-hoc clusters by shipping invocations
+to bigmachine workers over RPC (exec/bigmachine.go:79-533). The
+TPU-native replacement runs the SAME driver program on every host
+(jax.distributed): compilation is deterministic by construction (the
+Func-registry guarantee, SURVEY.md §7.1), so every process builds the
+identical task graph, evaluates it with an ordered device-group
+dispatcher (launch decisions are pure functions of task state — no
+wall-clock skips), and enters every jitted collective in the same order.
+Host-tier work runs redundantly on every process (deterministic), device
+groups run once across the global mesh with all_to_all/psum riding
+ICI/DCN, and group outputs gather to every host in launch order so
+result scans are collective-free.
+
+Contract: one driver thread per process, the same program on every
+process. Concurrent ``sess.run`` calls from multiple threads are a
+single-process-session feature only.
+
+Usage (every process runs this, same code)::
+
+    from bigslice_tpu.exec import spmd
+    sess = spmd.spmd_session()        # jax.distributed must be live
+    result = sess.run(build_pipeline)
+    if spmd.is_coordinator():
+        print(result.rows())
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from bigslice_tpu.utils.distributed import global_mesh, is_coordinator  # noqa: F401
+
+
+def spmd_session(mesh=None, parallelism: Optional[int] = None, **kwargs):
+    """A Session over the global multi-host mesh (call after
+    jax.distributed initialization; single-process meshes also work —
+    handy for tests)."""
+    from bigslice_tpu.exec.meshexec import MeshExecutor
+    from bigslice_tpu.exec.session import Session
+
+    if mesh is None:
+        mesh = global_mesh()
+    ex = MeshExecutor(mesh, fallback_procs=parallelism, spmd=True)
+    return Session(executor=ex, **kwargs)

@@ -315,10 +315,19 @@ class MeshReduceByKey:
                 overflow)
 
 
+def is_multiprocess_mesh(mesh) -> bool:
+    return len({d.process_index for d in mesh.devices.flat}) > 1
+
+
 def shard_columns(mesh, cols: Sequence[np.ndarray], counts: Sequence[int],
                   capacity: int):
     """Place per-shard host column chunks onto the mesh as global padded
     arrays: chunk i → device i, padded to `capacity` rows.
+
+    Multi-process meshes: every process calls with the SAME full
+    per-shard data (the SPMD driver model — deterministic host
+    computation everywhere); each contributes the rows of its own
+    devices via make_array_from_process_local_data.
 
     Returns (global_cols, global_counts) ready for MeshShuffle /
     MeshReduceByKey.
@@ -328,6 +337,24 @@ def shard_columns(mesh, cols: Sequence[np.ndarray], counts: Sequence[int],
 
     axis = mesh_axis(mesh)
     nshards = mesh.devices.size
+    sharding = NamedSharding(mesh, P(axis))
+    multi = is_multiprocess_mesh(mesh)
+    if multi:
+        pid = jax.process_index()
+        local = [i for i, d in enumerate(mesh.devices.flat)
+                 if d.process_index == pid]
+
+    def place(glob):
+        if not multi:
+            return jax.device_put(glob, sharding)
+        rows_per = glob.shape[0] // nshards
+        local_rows = np.concatenate([
+            glob[i * rows_per : (i + 1) * rows_per] for i in local
+        ])
+        return jax.make_array_from_process_local_data(
+            sharding, local_rows, glob.shape
+        )
+
     out = []
     for per_shard in cols:
         assert len(per_shard) == nshards
@@ -342,11 +369,8 @@ def shard_columns(mesh, cols: Sequence[np.ndarray], counts: Sequence[int],
             pad = np.zeros((capacity - len(chunk),) + chunk.shape[1:],
                            chunk.dtype)
             padded.append(np.concatenate([chunk, pad]))
-        glob = np.concatenate(padded)
-        out.append(jax.device_put(glob, NamedSharding(mesh, P(axis))))
-    counts_arr = jax.device_put(
-        np.asarray(counts, np.int32), NamedSharding(mesh, P(axis))
-    )
+        out.append(place(np.concatenate(padded)))
+    counts_arr = place(np.asarray(counts, np.int32))
     return out, counts_arr
 
 

@@ -104,9 +104,53 @@ def worker(num_processes: int, process_id: int, port: int,
     ))
     assert int(sums.sum()) == n * per, (int(sums.sum()), n * per)
 
+    # 3. The full distributed session: Session + MeshExecutor(spmd) on
+    # every process — compile, ordered device-group launch, collective
+    # execution, and result scan, all across real process boundaries
+    # (the exec/bigmachine.go:79-533 role, SPMD-style).
+    from bigslice_tpu.exec import spmd as spmd_mod
+    from bigslice_tpu.parallel.join import join_count_oracle
+    import bigslice_tpu as bs
+
+    sess = spmd_mod.spmd_session(mesh)
+
+    def add(a, b):
+        return a + b
+
+    skeys = rng.randint(0, 9, n * 24).astype(np.int32)
+    red = bs.Reduce(
+        bs.Filter(bs.Const(n, skeys, np.ones(len(skeys), np.int32)),
+                  lambda k, v: k != 4),
+        add,
+    )
+    got = dict(sess.run(red).rows())
+    expect: dict = {}
+    for kk in skeys.tolist():
+        if kk != 4:
+            expect[kk] = expect.get(kk, 0) + 1
+    assert got == expect, (got, expect)
+    assert sess.executor.device_group_count() >= 2
+
+    ak = rng.randint(0, 13, n * 16).astype(np.int32)
+    bk = rng.randint(5, 18, n * 16).astype(np.int32)
+    join = bs.JoinAggregate(
+        bs.Const(n, ak, np.ones(len(ak), np.int32)),
+        bs.Const(n, bk, np.ones(len(bk), np.int32)),
+        add, add,
+    )
+    got_j = {k: (int(a), int(b)) for k, a, b in sess.run(join).rows()}
+    assert got_j == join_count_oracle(ak.tolist(), bk.tolist())
+
+    # Iterative reuse across runs (Result as input) under SPMD.
+    base = sess.run(bs.Const(n, np.arange(n * 8, dtype=np.int32)))
+    doubled = sorted(sess.run(bs.Map(base, lambda x: x * 2)).rows())
+    assert doubled == [(2 * i,) for i in range(n * 8)]
+
     if process_id == 0:
         print(f"MULTIHOST_SMOKE_OK processes={num_processes} devices={n}",
               flush=True)
+        print("MULTIHOST_SESSION_OK "
+              f"groups={sess.executor.device_group_count()}", flush=True)
     try:
         jax.distributed.shutdown()
     except Exception:

@@ -21,3 +21,7 @@ def test_two_process_distributed_smoke():
     )
     assert out.returncode == 0, out.stderr[-2000:]
     assert "MULTIHOST_SMOKE_OK processes=2" in out.stdout
+    # The distributed Session ran end-to-end (compile → ordered SPMD
+    # group launch → collective execution → result scan) across the
+    # two processes with the device path engaged.
+    assert "MULTIHOST_SESSION_OK" in out.stdout
