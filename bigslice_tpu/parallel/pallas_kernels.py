@@ -2,29 +2,38 @@
 
 The reference's native tier is Go/unsafe kernels for the columnar hot
 ops (frame/unsafe.go, SURVEY.md §2.9); here it is Mosaic/Pallas. The
-first resident kernel fuses the shuffle's hottest pass — murmur-mix key
-hashing, partition-id assignment, and the per-destination histogram —
-into one VMEM-resident sweep (hash + mod + bincount would otherwise be
-separate XLA ops with an HBM round-trip for the histogram's sort-based
-lowering).
+resident kernel fuses the shuffle's hottest pass — murmur-mix key
+hashing (multi-column, combine-chained), validity masking, partition-id
+assignment, and the per-destination histogram — into one VMEM-resident
+sweep. Unfused, those are separate XLA ops with an HBM round trip and a
+scatter-lowered bincount.
 
 Layout: keys are processed as (rows, 128) lane-aligned blocks (the VPU's
 8×128 shape; last dim always 128 — pallas_guide.md tiling constraints).
 The histogram accumulates across sequential grid steps in a VMEM
 accumulator block (revisiting-output pattern).
 
+Key dtypes: int32/uint32 (value-cast) and float32 (bitcast with -0.0
+normalized), matching frame/ops.py ``_bits32`` bit-for-bit — the pallas
+path and the stock-XLA path must route every key identically.
+
 On CPU (tests, virtual mesh) the kernels run in interpreter mode;
-Mosaic compiles them natively on TPU.
+Mosaic compiles them natively on TPU (bench.py runs a TPU-gated
+equivalence check).
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Tuple
+from typing import Sequence, Tuple
 
 import numpy as np
 
 LANES = 128
+
+_GOLDEN32 = 0x9E3779B9
+
+SUPPORTED_KEY_DTYPES = ("int32", "uint32", "float32")
 
 
 def _interpret() -> bool:
@@ -33,32 +42,69 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def supports(key_cols: Sequence) -> bool:
+    """Can the fused kernel hash these key columns?"""
+    return all(
+        str(np.dtype(getattr(k, "dtype", None))) in SUPPORTED_KEY_DTYPES
+        for k in key_cols
+    )
+
+
 @functools.lru_cache(maxsize=64)
 def _build_hash_partition(nparts: int, block_rows: int, seed32: int,
-                          interpret: bool, with_counts: bool = True):
+                          key_dtypes: tuple, interpret: bool,
+                          with_counts: bool = True):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
+    nkeys = len(key_dtypes)
     # Histogram lanes: one partition per lane, padded to a lane multiple.
     hist_lanes = ((nparts + LANES - 1) // LANES) * LANES
 
-    def kernel(keys_ref, ids_ref, counts_ref=None):
-        step = pl.program_id(0)
-
+    def fmix(x):
         # murmur3 finalizer (matches frame/ops.py fmix32 bit-for-bit).
-        x = keys_ref[:].astype(jnp.uint32) ^ jnp.uint32(seed32)
         x = x ^ (x >> 16)
         x = x * jnp.uint32(0x85EBCA6B)
         x = x ^ (x >> 13)
         x = x * jnp.uint32(0xC2B2AE35)
         x = x ^ (x >> 16)
-        ids = (x % jnp.uint32(nparts)).astype(jnp.int32)
+        return x
+
+    def bits(ref, dtype):
+        v = ref[:]
+        if dtype == "float32":
+            # Normalize -0.0 → +0.0, then bitcast (frame/ops._bits32).
+            v = jnp.where(v == 0, jnp.zeros_like(v), v)
+            return jax.lax.bitcast_convert_type(v, jnp.uint32)
+        return v.astype(jnp.uint32)
+
+    def kernel(*refs):
+        mask_ref = refs[0]
+        key_refs = refs[1 : 1 + nkeys]
+        ids_ref = refs[1 + nkeys]
+        counts_ref = refs[2 + nkeys] if with_counts else None
+        step = pl.program_id(0)
+
+        h = None
+        for ref, dtype in zip(key_refs, key_dtypes):
+            kh = fmix(bits(ref, dtype) ^ jnp.uint32(seed32))
+            if h is None:
+                h = kh
+            else:
+                # combine_hashes (frame/ops.py): boost-style mix.
+                h = fmix(h ^ (kh + jnp.uint32(_GOLDEN32)
+                              + (h << 6) + (h >> 2)))
+        ids = (h % jnp.uint32(nparts)).astype(jnp.int32)
+        # Invalid (and padding) rows route to the drop lane `nparts`.
+        ids = jnp.where(mask_ref[:] != 0, ids, jnp.int32(nparts))
         ids_ref[:] = ids
 
         if counts_ref is not None:
             # Per-block histogram: compare against a lane iota and
-            # reduce over the block's rows/lanes.
+            # reduce over the block's rows/lanes. The drop lane id ==
+            # nparts never matches a counted lane (counts are sliced to
+            # [:nparts]); invalid rows therefore never count.
             pid = jax.lax.broadcasted_iota(
                 jnp.int32, (1, hist_lanes), dimension=1
             )
@@ -73,10 +119,11 @@ def _build_hash_partition(nparts: int, block_rows: int, seed32: int,
 
             counts_ref[:] += local
 
-    def run(keys2d):
-        rows = keys2d.shape[0]
+    def run(mask2d, *keys2d):
+        rows = mask2d.shape[0]
         grid = (rows // block_rows,)
-        out_specs = [pl.BlockSpec((block_rows, LANES), lambda i: (i, 0))]
+        blk = pl.BlockSpec((block_rows, LANES), lambda i: (i, 0))
+        out_specs = [blk]
         out_shape = [jax.ShapeDtypeStruct((rows, LANES), np.int32)]
         if with_counts:
             # Same accumulator block revisited every step.
@@ -88,13 +135,11 @@ def _build_hash_partition(nparts: int, block_rows: int, seed32: int,
         out = pl.pallas_call(
             kernel,
             grid=grid,
-            in_specs=[
-                pl.BlockSpec((block_rows, LANES), lambda i: (i, 0)),
-            ],
+            in_specs=[blk] * (1 + nkeys),
             out_specs=out_specs,
             out_shape=out_shape,
             interpret=interpret,
-        )(keys2d)
+        )(mask2d, *keys2d)
         return out if with_counts else (out[0], None)
 
     return jax.jit(run)
@@ -102,49 +147,50 @@ def _build_hash_partition(nparts: int, block_rows: int, seed32: int,
 
 def hash_partition(keys, nparts: int, seed: int = 0,
                    block_rows: int = 8,
-                   with_counts: bool = True) -> Tuple:
-    """Fused hash+partition(+histogram) over an int32 key column.
+                   with_counts: bool = True,
+                   valid=None) -> Tuple:
+    """Fused hash+mask+partition(+histogram) over key column(s).
 
-    Returns (ids int32[n], counts int32[nparts]) — ``counts`` is None
-    with ``with_counts=False`` (hash-only variant for callers that
-    re-count post-sort, e.g. the shuffle). Bit-identical to
-    ``frame_ops.hash_device_column(keys, seed) % nparts`` + bincount.
-    Rows are padded to a (block_rows, 128) grid; padding rows are
-    excluded from the histogram by the caller-visible contract (we
-    subtract them from their bucket).
+    ``keys`` is one array or a list of key columns (multi-column keys
+    combine in order, matching Frame.hash_keys). ``valid`` is an
+    optional bool mask; invalid rows get partition id ``nparts`` (the
+    drop lane) and are excluded from the histogram. Returns
+    (ids int32[n], counts int32[nparts] | None). Bit-identical to the
+    stock-XLA path: hash_device_column/combine_hashes % nparts.
     """
     import jax.numpy as jnp
 
     from bigslice_tpu.frame import ops as frame_ops
 
-    keys = jnp.asarray(keys)
-    n = keys.shape[0]
+    key_list = list(keys) if isinstance(keys, (list, tuple)) else [keys]
+    key_list = [jnp.asarray(k) for k in key_list]
+    n = key_list[0].shape[0]
     if n == 0:
-        # grid=(0,) would skip the accumulator init entirely, returning
-        # uninitialized counts on real hardware.
         return (jnp.zeros((0,), jnp.int32),
                 jnp.zeros((nparts,), jnp.int32) if with_counts else None)
     per_block = block_rows * LANES
     padded = ((n + per_block - 1) // per_block) * per_block
     npad = padded - n
-    flat = jnp.concatenate(
-        [keys.astype(jnp.int32), jnp.zeros((npad,), jnp.int32)]
-    )
-    keys2d = flat.reshape(-1, LANES)
+
+    def pad2d(col, fill):
+        flat = jnp.concatenate(
+            [col, jnp.full((npad,), fill, col.dtype)]
+        )
+        return flat.reshape(-1, LANES)
+
+    if valid is None:
+        valid = jnp.ones((n,), jnp.int32)
+    else:
+        valid = jnp.asarray(valid).astype(jnp.int32)
+    mask2d = pad2d(valid, 0)  # padding rows are invalid by construction
+    keys2d = [pad2d(k, k.dtype.type(0)) for k in key_list]
     fn = _build_hash_partition(
-        nparts, block_rows, int(frame_ops._seed32(seed)), _interpret(),
+        nparts, block_rows, int(frame_ops._seed32(seed)),
+        tuple(str(k.dtype) for k in key_list), _interpret(),
         with_counts,
     )
-    ids2d, counts = fn(keys2d)
+    ids2d, counts = fn(mask2d, *keys2d)
     ids = ids2d.reshape(-1)[:n]
     if not with_counts:
         return ids, None
-    counts = counts.reshape(-1)[:nparts]
-    if npad:
-        # Padding zeros all hashed into one known bucket; remove them.
-        zero_hash = frame_ops.fmix32(
-            np.zeros(1, np.uint32) ^ frame_ops._seed32(seed)
-        )
-        pad_bucket = int(zero_hash[0] % np.uint32(nparts))
-        counts = counts.at[pad_bucket].add(-npad)
-    return ids, counts
+    return ids, counts.reshape(-1)[:nparts]

@@ -79,12 +79,14 @@ def make_shuffle_fn(nshards: int, nkeys: int, capacity: int,
         masks (segmented reduce) chain without the extra sort."""
         size = cols[0].shape[0]
         keys = cols[:nkeys]
+        kernel_counts = None
         if partition_fn is not None:
             part = jnp.asarray(partition_fn(*keys)).astype(np.int32)
             # Out-of-range ids route to the drop lane and are counted in
             # the overflow signal rather than silently clipped.
             bad = (part < 0) | (part >= nparts)
             part = jnp.where(bad, np.int32(nparts), part)
+            part = jnp.where(valid, part, np.int32(nparts))
         else:
             bad = None
             enable_pallas = use_pallas
@@ -94,23 +96,26 @@ def make_shuffle_fn(nshards: int, nkeys: int, capacity: int,
                 # Mosaic-compiled on TPU; on CPU the interpreter is
                 # slower than the fused XLA ops, so default off.
                 enable_pallas = jax.default_backend() == "tpu"
-            if (enable_pallas and nkeys == 1
-                    and np.dtype(keys[0].dtype) == np.dtype(np.int32)):
-                # Native tier: fused murmur hash + partition ids
-                # (parallel/pallas_kernels.py), bit-identical to the
-                # XLA path below.
-                from bigslice_tpu.parallel import pallas_kernels as pk
+            from bigslice_tpu.parallel import pallas_kernels as pk
 
-                part, _ = pk.hash_partition(keys[0], nparts, seed,
-                                            with_counts=False)
+            if enable_pallas and pk.supports(keys):
+                # Native tier: ONE fused VMEM sweep for murmur hash,
+                # combine chain, validity routing, AND the destination
+                # histogram — replacing separate hash ops + where +
+                # scatter-lowered bincount. Bit-identical to the XLA
+                # path below.
+                part, kernel_counts = pk.hash_partition(
+                    list(keys), nparts, seed, with_counts=True,
+                    valid=valid,
+                )
             else:
                 h = None
                 for k in keys:
                     kh = frame_ops.hash_device_column(k, seed)
                     h = kh if h is None else frame_ops.combine_hashes(h, kh)
                 part = (h % np.uint32(nparts)).astype(np.int32)
-        # Invalid rows route to a virtual shard that sorts last.
-        part = jnp.where(valid, part, np.int32(nparts))
+                # Invalid rows route to a virtual shard that sorts last.
+                part = jnp.where(valid, part, np.int32(nparts))
         n_bad = (
             jnp.int32(0) if bad is None
             else (bad & valid).sum().astype(np.int32)
@@ -122,8 +127,12 @@ def make_shuffle_fn(nshards: int, nkeys: int, capacity: int,
         s_part = sorted_ops[0]
         s_cols = sorted_ops[1:]
 
-        # Row counts per destination and bucket-local offsets.
-        counts = jnp.bincount(s_part, length=nparts + 1)[:nparts]
+        # Row counts per destination and bucket-local offsets (the
+        # fused kernel already produced them on the pallas path).
+        counts = (
+            kernel_counts if kernel_counts is not None
+            else jnp.bincount(s_part, length=nparts + 1)[:nparts]
+        )
         starts = jnp.concatenate(
             [jnp.zeros(1, np.int32),
              jnp.cumsum(counts).astype(np.int32)[:-1]]

@@ -248,3 +248,45 @@ def test_multi_dep_combine_keys_attach_per_dep():
         assert ka and kb and ka != kb
         assert f"-{id(a)}-" in ka
         assert f"-{id(b)}-" in kb
+
+
+def test_golden_branch_materialize():
+    """A materialized mid-chain slice consumed by two branches: the
+    pipeline breaks at the pragma; both consumers read the same
+    materialized producer tasks (exec/testdata/branch-materialize
+    analog)."""
+    s = bs.Const(2, np.arange(4, dtype=np.int32),
+                 np.ones(4, dtype=np.int32))
+    m = bs.Map(s, lambda k, v: (k, v + 1))
+    m.pragmas = (bs.Materialize(),)
+    left = bs.Map(m, lambda k, v: (k, v * 2))
+    right = bs.Filter(m, lambda k, v: k > 0)
+    cg = bs.Cogroup(left, right)
+    check_golden("branch-materialize", graph(cg))
+
+
+def test_golden_different_partitions():
+    """One slice consumed at two different partition counts (Reduce at
+    its own shard count, Reshard to a different one): distinct producer
+    task sets with distinct names and partition configs
+    (exec/testdata/branch-different-partitions analog)."""
+    s = bs.Const(2, np.arange(8, dtype=np.int32),
+                 np.ones(8, dtype=np.int32))
+    a = bs.Reduce(s, lambda x, y: x + y)
+    b = bs.Reshard(bs.Prefixed(s, 1), 3)
+    cg = bs.Cogroup(
+        a, bs.Map(b, lambda k, v: (k, v))
+    )
+    check_golden("different-partitions", graph(cg))
+
+
+def test_golden_join_aggregate():
+    """JoinAggregate: two shuffle deps, each with its own map-side
+    combiner on its producers."""
+    a = bs.Const(2, np.arange(4, dtype=np.int32),
+                 np.ones(4, dtype=np.int32))
+    b = bs.Const(2, np.arange(4, dtype=np.int32),
+                 np.ones(4, dtype=np.int32))
+    j = bs.JoinAggregate(a, b, lambda x, y: x + y,
+                         lambda x, y: x * y)
+    check_golden("join-aggregate", graph(j))

@@ -44,3 +44,106 @@ def test_hash_partition_many_partitions():
     np.testing.assert_array_equal(
         np.asarray(counts), np.bincount(ref, minlength=200)
     )
+
+
+@pytest.mark.parametrize("dtype", [np.int32, np.uint32, np.float32])
+def test_hash_partition_dtypes(dtype):
+    rng = np.random.RandomState(9)
+    if dtype == np.float32:
+        keys = (rng.randn(1500) * 100).astype(np.float32)
+        keys[::97] = 0.0
+        keys[1::97] = -0.0  # -0.0 must route like +0.0
+    else:
+        keys = rng.randint(0, 2**31 - 1, 1500).astype(dtype)
+    ids, counts = pk.hash_partition(keys, 11, seed=2)
+    ref = (
+        frame_ops.hash_device_column(keys, 2) % np.uint32(11)
+    ).astype(np.int32)
+    np.testing.assert_array_equal(np.asarray(ids), ref)
+    np.testing.assert_array_equal(
+        np.asarray(counts), np.bincount(ref, minlength=11)
+    )
+
+
+def test_hash_partition_multikey():
+    rng = np.random.RandomState(4)
+    k1 = rng.randint(0, 1000, 2000).astype(np.int32)
+    k2 = (rng.randn(2000)).astype(np.float32)
+    ids, counts = pk.hash_partition([k1, k2], 13, seed=5)
+    h = frame_ops.hash_device_column(k1, 5)
+    h = frame_ops.combine_hashes(
+        h, frame_ops.hash_device_column(k2, 5)
+    )
+    ref = (h % np.uint32(13)).astype(np.int32)
+    np.testing.assert_array_equal(np.asarray(ids), ref)
+    np.testing.assert_array_equal(
+        np.asarray(counts), np.bincount(ref, minlength=13)
+    )
+
+
+def test_hash_partition_mask_routes_and_excludes():
+    rng = np.random.RandomState(6)
+    keys = rng.randint(0, 10000, 1000).astype(np.int32)
+    valid = rng.rand(1000) < 0.6
+    ids, counts = pk.hash_partition(keys, 7, seed=1, valid=valid)
+    ids = np.asarray(ids)
+    ref = (
+        frame_ops.hash_device_column(keys, 1) % np.uint32(7)
+    ).astype(np.int32)
+    np.testing.assert_array_equal(ids[valid], ref[valid])
+    assert (ids[~valid] == 7).all()  # drop lane
+    np.testing.assert_array_equal(
+        np.asarray(counts), np.bincount(ref[valid], minlength=7)
+    )
+
+
+def test_shuffle_pallas_path_matches_xla_path():
+    """The full shuffle body with use_pallas on/off produces identical
+    routing, counts, and payloads (interpret mode here; Mosaic on TPU
+    via the bench gate)."""
+    import jax
+    import jax.numpy as jnp
+
+    from bigslice_tpu.parallel.shuffle import make_shuffle_fn
+
+    rng = np.random.RandomState(12)
+    cap, nshards = 256, 4
+    keys = rng.randint(0, 5000, cap).astype(np.int32)
+    vals = rng.randint(0, 100, cap).astype(np.int32)
+    n = 200
+
+    outs = []
+    for use_pallas in (False, True):
+        body = make_shuffle_fn(nshards, 1, cap, axis="s",
+                               use_pallas=use_pallas)
+
+        def run(n_, keys_, vals_):
+            c, o, out_cols = body(n_[0], keys_, vals_)
+            return c.reshape(1), o, tuple(out_cols)
+
+        mesh = jax.sharding.Mesh(np.array(jax.devices()[:nshards]),
+                                 ("s",))
+        from bigslice_tpu.parallel.meshutil import get_shard_map
+        from jax.sharding import PartitionSpec as P
+
+        sm = get_shard_map()
+        prog = jax.jit(sm(
+            run, mesh=mesh,
+            in_specs=(P("s"), P("s"), P("s")),
+            out_specs=(P("s"), P(), tuple([P("s"), P("s")])),
+        ))
+        from jax.sharding import NamedSharding
+
+        sh = NamedSharding(mesh, P("s"))
+        out_counts, ov, cols = prog(
+            jax.device_put(np.full(nshards, n, np.int32), sh),
+            jax.device_put(np.tile(keys, nshards), sh),
+            jax.device_put(np.tile(vals, nshards), sh),
+        )
+        outs.append((np.asarray(out_counts), int(ov),
+                     [np.asarray(c) for c in cols]))
+    (c0, o0, cols0), (c1, o1, cols1) = outs
+    np.testing.assert_array_equal(c0, c1)
+    assert o0 == o1
+    for a, b in zip(cols0, cols1):
+        np.testing.assert_array_equal(a, b)
