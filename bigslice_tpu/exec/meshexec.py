@@ -128,6 +128,21 @@ class _BridgedStore(store_mod.MemoryStore):
                 or self.owner._has_device_output(name))
 
 
+class WavedGroupOutput:
+    """Per-wave outputs of a group with more shards than devices
+    (unpartitioned chains keep shard identity: shard s lives in wave
+    s // nmesh at device s % nmesh)."""
+
+    def __init__(self, waves: List[DeviceGroupOutput], nmesh: int):
+        self.waves = waves
+        self.nmesh = nmesh
+        self.partitioned = False  # merged outputs use DeviceGroupOutput
+
+    def gather(self) -> None:
+        for w in self.waves:
+            w.gather()
+
+
 class _GroupState:
     def __init__(self, num_shard: int):
         self.num_shard = num_shard
@@ -320,12 +335,14 @@ class MeshExecutor:
     # -- eligibility ------------------------------------------------------
 
     def _eligible(self, task: Task) -> bool:
-        # Padded-mesh groups: op shard counts up to the mesh size run
-        # SPMD with trailing devices holding empty shards (the S < N
-        # case; S > N groups still fall back pending wave scheduling).
-        # Output partition counts are independent of the shard count
-        # (Reshard changes them) but must also fit the mesh.
-        if task.chain is None or task.name.num_shard > self.nmesh:
+        # Shard counts and the mesh size decouple: S < N pads the mesh
+        # with empty shards; S > N streams k waves of N shards through
+        # the device sequentially (the beyond-HBM input scaling
+        # mechanism — shard data lives on device only for its wave).
+        # Output partition counts must fit the mesh (consumers wider
+        # than the mesh read via the store bridge / fallback; Reshard
+        # down to the mesh for device-resident chaining).
+        if task.chain is None:
             return False
         if task.num_partition > self.nmesh:
             return False
@@ -349,9 +366,6 @@ class MeshExecutor:
             if part.combiner is not None and not getattr(
                 part.combiner, "device", False
             ):
-                return False
-        for dep in task.deps:
-            if len(dep.tasks) > self.nmesh:
                 return False
         from bigslice_tpu.ops.const import Const
         from bigslice_tpu.ops.fold import Fold
@@ -534,7 +548,32 @@ class MeshExecutor:
 
     def _execute_group(self, key, tasks: List[Task]) -> None:
         task0 = tasks[0]
-        inputs = self._group_inputs(tasks)
+        if len(tasks) > self.nmesh:
+            # Wave scheduling: stream ceil(S/N) waves of N shards
+            # through the device. Partitioned outputs merge on-device
+            # across waves (consumers re-combine/concat per their
+            # semantics — wave contributions are just multiple
+            # producers); unpartitioned outputs keep per-wave shard
+            # identity for aligned consumers and the store bridge.
+            N = self.nmesh
+            wave_outs = []
+            for w in range((len(tasks) + N - 1) // N):
+                wave_outs.append(self._execute_wave(
+                    tasks[w * N : (w + 1) * N], wave=w
+                ))
+            if task0.num_partition > 1:
+                self._outputs[key] = self._merge_outputs(wave_outs,
+                                                         task0)
+            else:
+                self._outputs[key] = WavedGroupOutput(wave_outs,
+                                                      self.nmesh)
+            return
+        self._outputs[key] = self._execute_wave(tasks, wave=0)
+
+    def _execute_wave(self, tasks: List[Task],
+                      wave: int) -> DeviceGroupOutput:
+        task0 = tasks[0]
+        inputs = self._group_inputs(tasks, wave)
         caps = tuple(c for _, _, c in inputs)
         counts_list = [c for _, c, _ in inputs]
         cols_flat = [c for colset, _, _ in inputs for c in colset]
@@ -594,12 +633,74 @@ class MeshExecutor:
                                         task0.num_partition, slack)
             if has_shuffle else base_capacity
         )
-        self._outputs[key] = DeviceGroupOutput(
+        return DeviceGroupOutput(
             list(out_cols), out_counts, out_capacity, task0.schema,
             partitioned=task0.num_partition > 1,
         )
 
-    def _group_inputs(self, tasks: List[Task]):
+    def _merge_outputs(self, outs: List[DeviceGroupOutput],
+                       task0: Task) -> DeviceGroupOutput:
+        """Merge all waves' partitioned outputs per device in ONE W-way
+        concat + recompact program (O(W·cap) data movement, one
+        compilation per (shape, W)). Consumers treat the merged rows as
+        multiple producer contributions — combiner-bearing consumers
+        re-combine, concat consumers concat."""
+        if len(outs) == 1:
+            return outs[0]
+        ncols = len(task0.schema)
+        dtypes = tuple(str(ct.dtype) for ct in task0.schema)
+        caps = tuple(o.capacity for o in outs)
+        W = len(outs)
+        key = ("merge", ncols, caps, dtypes)
+        with self._lock:
+            cached = self._programs.get(key)
+        if cached is not None:
+            prog = cached[0]
+        else:
+            import jax
+            import jax.numpy as jnp
+            from jax.sharding import PartitionSpec as P
+
+            axis = mesh_axis(self.mesh)
+            shard_map = get_shard_map()
+
+            def stepped(*args):
+                counts = args[:W]  # one int32[1] per wave
+                flat = args[W:]    # W blocks of ncols columns
+                mask = jnp.concatenate([
+                    jnp.arange(caps[w], dtype=np.int32) < counts[w][0]
+                    for w in range(W)
+                ])
+                merged = [
+                    jnp.concatenate([flat[w * ncols + j]
+                                     for w in range(W)])
+                    for j in range(ncols)
+                ]
+                n, packed = segment.compact_by_mask(mask, merged)
+                return n.reshape(1), tuple(packed)
+
+            col = P(axis)
+            prog = jax.jit(shard_map(
+                stepped, mesh=self.mesh,
+                in_specs=tuple(col for _ in range(W))
+                + tuple(col for _ in range(W * ncols)),
+                out_specs=(col, tuple(col for _ in range(ncols))),
+                check_rep=False,
+            ))
+            with self._lock:
+                self._programs[key] = (prog, ())
+                while len(self._programs) > _PROGRAM_CACHE_MAX:
+                    self._programs.pop(next(iter(self._programs)))
+        counts, cols = prog(
+            *[o.counts for o in outs],
+            *[c for o in outs for c in o.cols],
+        )
+        return DeviceGroupOutput(
+            list(cols), counts, sum(caps), task0.schema,
+            partitioned=True,
+        )
+
+    def _group_inputs(self, tasks: List[Task], wave: int = 0):
         """Build [(global cols, counts, capacity)] — one entry per dep
         (or one host-source upload for dependency-less chains)."""
         task0 = tasks[0]
@@ -611,15 +712,23 @@ class MeshExecutor:
                     t.chain[-1].schema,
                 ).to_host() for t in tasks]
             )]
-        return [self._dep_input(tasks, i)
+        return [self._dep_input(tasks, i, wave)
                 for i in range(len(task0.deps))]
 
-    def _dep_input(self, tasks: List[Task], dep_idx: int):
+    def _dep_input(self, tasks: List[Task], dep_idx: int,
+                   wave: int = 0):
         """(global cols, counts, capacity) for one dep of the group."""
         task0 = tasks[0]
         dep0 = task0.deps[dep_idx]
         pkey = dep0.tasks[0].group_key
         out = self._outputs.get(pkey)
+        if isinstance(out, WavedGroupOutput):
+            if len(dep0.tasks) == 1:
+                # Aligned dep on a waved producer: consumer wave w's
+                # shards align with producer wave w (same mesh size).
+                wout = out.waves[wave]
+                return wout.cols, wout.counts, wout.capacity
+            out = None  # read through the store bridge per shard
         if out is not None and out.partitioned:
             # Device-resident shuffle output: device p already holds
             # partition p == consumer shard p (for any producer shard
@@ -970,8 +1079,17 @@ class MeshExecutor:
             out = self._outputs.get(key)
         if out is None:
             return None
-        chunks = out.host_chunks()
         shard = task.name.shard
+        if isinstance(out, WavedGroupOutput):
+            if partition != 0:
+                return []
+            wout = out.waves[shard // out.nmesh]
+            chunks = wout.host_chunks()
+            cols = [c[shard % out.nmesh] for c in chunks]
+            if not len(cols[0]):
+                return []
+            return [Frame(cols, task.schema)]
+        chunks = out.host_chunks()
         if out.partitioned:
             # Post-shuffle: device p holds partition p merged over
             # sources; attribute it all to producer shard 0 so the union

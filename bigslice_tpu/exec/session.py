@@ -143,7 +143,8 @@ class Session:
                  monitor=None, trace_path: Optional[str] = None,
                  status: bool = False, eventer=None,
                  machine_combiners: bool = False,
-                 debug_port: Optional[int] = None):
+                 debug_port: Optional[int] = None,
+                 xprof_dir: Optional[str] = None):
         from bigslice_tpu.utils import status as status_mod
         from bigslice_tpu.utils import trace as trace_mod
 
@@ -172,6 +173,13 @@ class Session:
             from bigslice_tpu.utils.debughttp import DebugServer
 
             self.debug = DebugServer(self, debug_port)
+        # XLA-level profiling (SURVEY.md §5.1 mapping): every run's
+        # evaluation is wrapped in a jax.profiler trace, producing
+        # XPlane files under xprof_dir (one trace per run) for
+        # TensorBoard/xprof — kernel-level timing to complement the
+        # task-level Chrome trace (trace_path).
+        self.xprof_dir = xprof_dir
+        self._xprof_lock = threading.Lock()
         self._inv_index = itertools.count(1)
         self._gate = _InvocationGate()
         executor.start(self)
@@ -252,9 +260,30 @@ class Session:
         # Exclusive invocations evaluate in isolation from concurrent
         # runs of this session; their own shards stay parallel.
         self._gate.acquire(exclusive)
+        xprof = None
         try:
+            if (self.xprof_dir
+                    and self._xprof_lock.acquire(blocking=False)):
+                # One active XPlane trace at a time (concurrent runs
+                # skip). Profiler failures (unwritable dir, another
+                # live profiler) must not leak the gate or the lock.
+                try:
+                    import jax
+
+                    xprof = jax.profiler.trace(self.xprof_dir)
+                    xprof.__enter__()
+                except Exception:
+                    xprof = None
+                    self._xprof_lock.release()
             evaluate(self.executor, tasks, monitor=self.monitor)
         finally:
+            if xprof is not None:
+                try:
+                    xprof.__exit__(None, None, None)
+                except Exception:
+                    pass
+                finally:
+                    self._xprof_lock.release()
             self._gate.release(exclusive)
             finish = getattr(self.executor, "finish_run", None)
             if finish is not None:

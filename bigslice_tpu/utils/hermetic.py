@@ -24,27 +24,50 @@ def force_hermetic_cpu() -> None:
         pass
 
 
-def ensure_usable_backend(timeout: float = 90.0) -> str:
+def ensure_usable_backend(timeout: float = None, retries: int = None,
+                          backoff: float = 20.0) -> str:
     """Probe device init in a subprocess; a wedged TPU tunnel hangs
     inside native code (unkillable in-process), so probe out-of-process
-    and fall back to hermetic CPU rather than hanging the caller.
-    Returns "default" (healthy) or "cpu-fallback"."""
+    — with retries and backoff, since tunnel wedges are often transient
+    (relay restarts) — and fall back to hermetic CPU only after the
+    last attempt fails. Returns "default" (healthy) or "cpu-fallback".
+
+    Env knobs: BIGSLICE_BACKEND_PROBE_RETRIES / _TIMEOUT override the
+    DEFAULTS only (explicit caller arguments win; the driver can afford
+    a longer courtship than tests)."""
     import os
     import subprocess
     import sys
+    import time
 
     if os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip() == "cpu":
         # Already pinned to CPU (tests, hermetic tools): nothing to probe.
         force_hermetic_cpu()
         return "cpu"
-    try:
-        subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=timeout, capture_output=True, check=True,
+    if retries is None:
+        retries = int(os.environ.get("BIGSLICE_BACKEND_PROBE_RETRIES", 3))
+    if timeout is None:
+        timeout = float(
+            os.environ.get("BIGSLICE_BACKEND_PROBE_TIMEOUT", 90.0)
         )
-        return "default"
-    except (subprocess.TimeoutExpired, subprocess.CalledProcessError):
-        print("bigslice_tpu: device backend unavailable (tunnel hang?); "
-              "falling back to CPU", file=sys.stderr)
-        force_hermetic_cpu()
-        return "cpu-fallback"
+    for attempt in range(max(1, retries)):
+        try:
+            subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                timeout=timeout, capture_output=True, check=True,
+            )
+            return "default"
+        except (subprocess.TimeoutExpired,
+                subprocess.CalledProcessError):
+            if attempt + 1 < retries:
+                print(
+                    f"bigslice_tpu: device backend probe failed "
+                    f"(attempt {attempt + 1}/{retries}); retrying in "
+                    f"{backoff:.0f}s", file=sys.stderr,
+                )
+                time.sleep(backoff)
+                backoff *= 2
+    print("bigslice_tpu: device backend unavailable (tunnel hang?); "
+          "falling back to CPU", file=sys.stderr)
+    force_hermetic_cpu()
+    return "cpu-fallback"
