@@ -346,9 +346,14 @@ class MeshExecutor:
             return False
         if task.num_partition > self.nmesh:
             return False
-        if not all(ct.is_device and ct.shape == ()
-                   for ct in task.schema):
-            # Vector columns can't ride the sort-based device stages.
+        if not all(ct.is_device for ct in task.schema):
+            return False
+        if task.num_partition > 1 and not all(
+            ct.shape == () for ct in task.schema
+        ):
+            # Vector columns (GroupByKey matrices) can't ride the
+            # shuffle's sort; groups with vector outputs must be roots
+            # or aligned producers.
             return False
         part = task.partitioner
         if part.combine_key or any(d.combine_key for d in task.deps):
@@ -369,6 +374,7 @@ class MeshExecutor:
                 return False
         from bigslice_tpu.ops.const import Const
         from bigslice_tpu.ops.fold import Fold
+        from bigslice_tpu.ops.groupby import GroupByKey
         from bigslice_tpu.ops.join import JoinAggregate
         from bigslice_tpu.ops.mapops import (
             Filter,
@@ -400,6 +406,12 @@ class MeshExecutor:
                 continue
             if isinstance(s, Fold):
                 if not s.device:
+                    return False
+                continue
+            if isinstance(s, GroupByKey):
+                # Consumes the raw shuffled dep: innermost only (its
+                # own op typechecks scalar-device inputs).
+                if s is not task.chain[-1]:
                     return False
                 continue
             if isinstance(s, JoinAggregate):
@@ -779,6 +791,7 @@ class MeshExecutor:
         """Flatten the chain (innermost→outermost) + output partitioner
         into device stage descriptors (kind, struct_id, slice)."""
         from bigslice_tpu.ops.fold import Fold
+        from bigslice_tpu.ops.groupby import GroupByKey
         from bigslice_tpu.ops.join import JoinAggregate
         from bigslice_tpu.ops.mapops import Filter, Flatmap, Head, Map
         from bigslice_tpu.ops.reduce import Reduce
@@ -804,6 +817,8 @@ class MeshExecutor:
                      str(s.acc_dtype)),
                     s,
                 ))
+            elif isinstance(s, GroupByKey):
+                stages.append(("groupby", (s.prefix, s.capacity), s))
             elif isinstance(s, JoinAggregate):
                 fa, fb = s.frame_combiners
                 stages.append((
@@ -968,6 +983,17 @@ class MeshExecutor:
                         mask, tuple(cols[:nk]), tuple(cols[nk:])
                     )
                     cols = list(keys) + list(accs)
+                elif kind == "groupby":
+                    from bigslice_tpu.parallel.groupby import (
+                        make_group_by_key_masked,
+                    )
+
+                    core = make_group_by_key_masked(s.prefix,
+                                                    s.capacity)
+                    mask, keys, groups, counts = core(
+                        mask, tuple(cols[: s.prefix]), cols[s.prefix]
+                    )
+                    cols = list(keys) + [groups, counts]
                 else:  # shuffle
                     part = s.partitioner
                     fc = part.combiner

@@ -101,6 +101,49 @@ class DeviceGroupByKey:
         )
 
 
+def make_group_by_key_masked(nkeys: int, capacity: int):
+    """Mask-chained grouping core for the mesh executor's SPMD programs:
+    ``core(mask, key_cols, val_col) -> (head_mask, keys, groups, counts)``
+    where rows stay in sorted position, group-head rows carry the
+    [capacity]-wide group matrix row and the true count, and
+    ``head_mask`` selects them (compact with the vector-capable
+    segment.compact_by_mask)."""
+    import jax.numpy as jnp
+
+    from bigslice_tpu.parallel.segment import sort_and_segment
+
+    G = capacity
+
+    def core(mask, key_cols, val_col):
+        size = val_col.shape[0]
+        s_invalid, s_keys, (s_val,), diff = sort_and_segment(
+            nkeys, mask, key_cols, (val_col,)
+        )
+        valid_row = s_invalid == 0
+        is_head = diff & valid_row
+        seg_id = jnp.cumsum(diff.astype(np.int32)) - 1
+        seg_len_all = jnp.zeros((size + 1,), np.int32).at[
+            jnp.where(valid_row, seg_id, size)
+        ].add(1, mode="drop")[:size]
+        counts_row = seg_len_all[seg_id]
+        idx = jnp.arange(size, dtype=np.int32)
+        # Segment rows are contiguous post-sort: each head gathers its
+        # own [G] window (clipped), masked by the true length.
+        offsets = jnp.minimum(
+            idx[:, None] + jnp.arange(G, dtype=np.int32)[None, :],
+            size - 1,
+        )
+        gathered = s_val[offsets]
+        in_group = (jnp.arange(G, dtype=np.int32)[None, :]
+                    < jnp.minimum(counts_row, G)[:, None])
+        groups_row = jnp.where(in_group & is_head[:, None], gathered,
+                               jnp.zeros((), val_col.dtype))
+        counts_row = jnp.where(is_head, counts_row, 0)
+        return is_head, list(s_keys), groups_row, counts_row
+
+    return core
+
+
 _GROUPBY_CACHE: dict = {}
 
 

@@ -66,12 +66,23 @@ def sort_and_segment(nkeys: int, valid_mask, key_cols, payload):
 def compact_by_mask(mask, cols):
     """Front-compact rows selected by ``mask`` (stable; preserves the
     relative order of survivors). Returns (count, cols). The one shared
-    implementation of the capacity+validity → front-packed conversion."""
+    implementation of the capacity+validity → front-packed conversion.
+
+    Scalar columns ride ``lax.sort`` directly; vector columns (trailing
+    dims — GroupByKey matrices) can't be sort operands, so mixed
+    column sets compact via a sorted permutation + gather instead."""
     import jax.numpy as jnp
     from jax import lax
 
     inv = (~mask).astype(np.int32)
-    packed = lax.sort((inv,) + tuple(cols), num_keys=1, is_stable=True)
+    cols = tuple(cols)
+    if any(getattr(c, "ndim", 1) > 1 for c in cols):
+        size = cols[0].shape[0]
+        iota = jnp.arange(size, dtype=np.int32)
+        _, perm = lax.sort((inv, iota), num_keys=1, is_stable=True)
+        return (mask.sum().astype(np.int32),
+                tuple(jnp.take(c, perm, axis=0) for c in cols))
+    packed = lax.sort((inv,) + cols, num_keys=1, is_stable=True)
     return mask.sum().astype(np.int32), tuple(packed[1:])
 
 

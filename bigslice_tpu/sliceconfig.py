@@ -103,6 +103,13 @@ def set_current_session(sess) -> None:
 def parse(argv=None):
     """Merge profile + flags and build a Session (sliceconfig.Parse
     analog). Returns (session, leftover_args)."""
+    from bigslice_tpu.utils.hermetic import force_hermetic_cpu, is_cpu_pinned
+
+    if is_cpu_pinned():
+        # CPU-pinned runs (tests, -local tooling) must not touch the
+        # TPU-tunnel plugin, which hooks backend init regardless of
+        # JAX_PLATFORMS and hangs when the tunnel is wedged.
+        force_hermetic_cpu()
     cfg = load_profile()
     ap = argparse.ArgumentParser(add_help=False)
     ap.add_argument("-local", action="store_true",

@@ -87,11 +87,48 @@ def run_shuffle(sess, rows, shards):
     return n
 
 
+def run_join(sess, rows, shards):
+    """Aggregating join at scale (the BASELINE Reduce+Cogroup shape)."""
+    import bigslice_tpu as bs
+    from bigslice_tpu.parallel.join import join_count_oracle
+
+    k1, _ = _data(rows, max(1, rows // 20), seed=3)
+    k2, _ = _data(rows, max(1, rows // 20), seed=4)
+    ones = np.ones(rows, np.int32)
+    res = sess.run(bs.JoinAggregate(
+        bs.Const(shards, k1, ones), bs.Const(shards, k2, ones),
+        _add, _add,
+    ))
+    got = {k: (int(a), int(b)) for k, a, b in res.rows()}
+    assert got == join_count_oracle(k1.tolist(), k2.tolist())
+    return len(got)
+
+
+def run_waves(sess, rows, shards):
+    """Wave-streaming soak: the source runs with several times more
+    shards than the mesh (at least 4x, whatever -shards says), so the
+    group streams through the device in waves before resharding down."""
+    import bigslice_tpu as bs
+    import jax
+
+    shards = max(shards, 4 * len(jax.devices()))
+    keys, vals = _data(rows, max(1, rows // 100), seed=5)
+    res = sess.run(bs.Reduce(
+        bs.Reshard(bs.Prefixed(bs.Const(shards, keys, vals), 1), 8),
+        _add,
+    ))
+    total = sum(v for _, v in res.rows())
+    assert total == int(vals.sum())
+    return total
+
+
 MODES = {
     "reduce": run_reduce,
     "cogroup": run_cogroup,
     "memiter": run_memiter,
     "shuffle": run_shuffle,
+    "join": run_join,
+    "waves": run_waves,
 }
 
 

@@ -10,6 +10,16 @@ CPU-only tools deregister it outright through this one shared helper
 from __future__ import annotations
 
 
+def is_cpu_pinned() -> bool:
+    """True when the primary JAX platform is pinned to cpu via the
+    environment (tests, -local tooling) — the one shared definition."""
+    import os
+
+    return os.environ.get(
+        "JAX_PLATFORMS", ""
+    ).split(",")[0].strip() == "cpu"
+
+
 def force_hermetic_cpu() -> None:
     import os
 
@@ -40,7 +50,7 @@ def ensure_usable_backend(timeout: float = None, retries: int = None,
     import sys
     import time
 
-    if os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip() == "cpu":
+    if is_cpu_pinned():
         # Already pinned to CPU (tests, hermetic tools): nothing to probe.
         force_hermetic_cpu()
         return "cpu"
