@@ -54,6 +54,29 @@ GROUP_WAIT_SECS = 0.25
 _PROGRAM_CACHE_MAX = 64
 
 
+class HostLostError(RuntimeError):
+    """A peer process died mid-collective — the gang-scheduled SPMD
+    analog of machine loss (SURVEY §5.3 mapping): unlike the
+    reference's per-machine task retry, a lost gang member fails the
+    whole step. Recovery is program-level: restart the SPMD driver
+    (every process), and Cache/store materialization short-circuits
+    recomputation of finished stages."""
+
+
+# Multi-word markers only: a user error merely *mentioning* "peer" or
+# "distributed" must not be rewrapped with restart-the-fleet advice.
+_DIST_ERR_MARKERS = (
+    "gloo", "connection reset", "coordination service",
+    "stopped sending heartbeats", "preempt",
+    "distributed service detected fatal errors",
+)
+
+
+def _looks_like_host_loss(e: BaseException) -> bool:
+    text = repr(e).lower()
+    return any(m in text for m in _DIST_ERR_MARKERS)
+
+
 class DeviceGroupOutput:
     """A group's output resident on the mesh: row-sharded global columns
     plus per-device valid counts. When ``partitioned``, device p holds
@@ -61,12 +84,16 @@ class DeviceGroupOutput:
     holds shard s's output."""
 
     def __init__(self, cols, counts, capacity: int, schema,
-                 partitioned: bool):
+                 partitioned: bool, subid: bool = False):
         self.cols = cols
         self.counts = counts
         self.capacity = capacity
         self.schema = schema
         self.partitioned = partitioned
+        # Wave-partitioned shuffle outputs (num_partition > mesh) carry
+        # an int32 subid as cols[0]: partition p lives on device
+        # p % nmesh with subid p // nmesh.
+        self.subid = subid
         self._chunks = None
         self._chunks_lock = threading.Lock()
 
@@ -344,8 +371,6 @@ class MeshExecutor:
         # down to the mesh for device-resident chaining).
         if task.chain is None:
             return False
-        if task.num_partition > self.nmesh:
-            return False
         if not all(ct.is_device for ct in task.schema):
             return False
         if task.num_partition > 1 and not all(
@@ -553,6 +578,13 @@ class MeshExecutor:
             for t in claimed:
                 t.mark_lost(e)
         except Exception as e:  # noqa: BLE001
+            if self.multiprocess and _looks_like_host_loss(e):
+                e = HostLostError(
+                    f"peer process lost during SPMD group "
+                    f"{tasks[0].name.op}: restart the driver on every "
+                    f"process (Cache/store short-circuits recompute); "
+                    f"cause: {e!r}"
+                )
             for t in claimed:
                 t.set_state(TaskState.ERR, e)
 
@@ -586,9 +618,10 @@ class MeshExecutor:
                       wave: int) -> DeviceGroupOutput:
         task0 = tasks[0]
         inputs = self._group_inputs(tasks, wave)
-        caps = tuple(c for _, _, c in inputs)
-        counts_list = [c for _, c, _ in inputs]
-        cols_flat = [c for colset, _, _ in inputs for c in colset]
+        caps = tuple(i[2] for i in inputs)
+        counts_list = [i[1] for i in inputs]
+        cols_flat = [c for i in inputs for c in i[0]]
+        subids = tuple(i[3] for i in inputs)
         # A join stage concatenates its two inputs; flatmap stages grow
         # the buffer by their fanout — track the working buffer size the
         # chain carries into its output/shuffle stage.
@@ -608,15 +641,20 @@ class MeshExecutor:
         # §7.3(1)/(5) — a bounded set of compiled programs, no dynamic
         # shapes.
         slack = 2.0
+        # Wave-partitioned output: more partitions than devices → the
+        # shuffle routes per device with a subid payload column.
+        out_subid = task0.num_partition > self.nmesh
+        ndest = min(task0.num_partition, self.nmesh)
         while True:
-            program, stages = self._program(task0, caps, slack)
+            program, stages = self._program(task0, caps, slack,
+                                            subids=subids)
             extras = [
                 np.asarray(a)
                 for kind, _, s in stages if kind == "map"
                 for a in s.args
             ]
             out_counts, overflow, badrange, out_cols = program(
-                *counts_list, *cols_flat, *extras
+                np.int32(wave), *counts_list, *cols_flat, *extras
             )
             has_shuffle = any(k == "shuffle" for k, _, _ in stages)
             if has_shuffle and int(np.asarray(badrange)) > 0:
@@ -630,9 +668,9 @@ class MeshExecutor:
                 )
             if not has_shuffle or int(np.asarray(overflow)) == 0:
                 break
-            # slack == nparts makes overflow impossible (a source can
-            # send at most `capacity` rows to one destination).
-            full_slack = float(max(2, task0.num_partition))
+            # slack == ndest makes overflow impossible (a source can
+            # send at most `capacity` rows to one destination lane).
+            full_slack = float(max(2, ndest))
             if slack >= full_slack:
                 raise RuntimeError(
                     f"mesh shuffle overflow in group {task0.name.op} "
@@ -641,13 +679,13 @@ class MeshExecutor:
             slack = min(slack * 4, full_slack)
         out_capacity = (
             self.nmesh
-            * shuffle_mod.send_capacity(base_capacity,
-                                        task0.num_partition, slack)
+            * shuffle_mod.send_capacity(base_capacity, ndest, slack)
             if has_shuffle else base_capacity
         )
         return DeviceGroupOutput(
             list(out_cols), out_counts, out_capacity, task0.schema,
             partitioned=task0.num_partition > 1,
+            subid=has_shuffle and out_subid,
         )
 
     def _merge_outputs(self, outs: List[DeviceGroupOutput],
@@ -659,8 +697,11 @@ class MeshExecutor:
         re-combine, concat consumers concat."""
         if len(outs) == 1:
             return outs[0]
-        ncols = len(task0.schema)
-        dtypes = tuple(str(ct.dtype) for ct in task0.schema)
+        # Wave-partitioned outputs carry a leading subid column beyond
+        # the schema; merge whatever columns the outputs actually have.
+        ncols = len(outs[0].cols)
+        dtypes = ((("int32",) if outs[0].subid else ())
+                  + tuple(str(ct.dtype) for ct in task0.schema))
         caps = tuple(o.capacity for o in outs)
         W = len(outs)
         key = ("merge", ncols, caps, dtypes)
@@ -709,7 +750,7 @@ class MeshExecutor:
         )
         return DeviceGroupOutput(
             list(cols), counts, sum(caps), task0.schema,
-            partitioned=True,
+            partitioned=True, subid=outs[0].subid,
         )
 
     def _group_inputs(self, tasks: List[Task], wave: int = 0):
@@ -729,7 +770,7 @@ class MeshExecutor:
 
     def _dep_input(self, tasks: List[Task], dep_idx: int,
                    wave: int = 0):
-        """(global cols, counts, capacity) for one dep of the group."""
+        """(global cols, counts, capacity, has_subid) for one dep."""
         task0 = tasks[0]
         dep0 = task0.deps[dep_idx]
         pkey = dep0.tasks[0].group_key
@@ -739,18 +780,20 @@ class MeshExecutor:
                 # Aligned dep on a waved producer: consumer wave w's
                 # shards align with producer wave w (same mesh size).
                 wout = out.waves[wave]
-                return wout.cols, wout.counts, wout.capacity
+                return wout.cols, wout.counts, wout.capacity, False
             out = None  # read through the store bridge per shard
         if out is not None and out.partitioned:
-            # Device-resident shuffle output: device p already holds
-            # partition p == consumer shard p (for any producer shard
-            # count — routing is partition-addressed). Zero-copy reuse.
-            return out.cols, out.counts, out.capacity
+            # Device-resident shuffle output: device p % nmesh holds
+            # partition p (for any producer shard count — routing is
+            # partition-addressed). Zero-copy reuse; wave-partitioned
+            # outputs carry the subid column the consuming program
+            # filters on.
+            return out.cols, out.counts, out.capacity, out.subid
         if (out is not None and len(dep0.tasks) == 1
                 and not out.partitioned):
             # Aligned (materialize-boundary) dep, device-resident:
             # device s holds producer shard s == consumer shard s.
-            return out.cols, out.counts, out.capacity
+            return out.cols, out.counts, out.capacity, False
         # Fallback-produced dep: load frames from the store per shard.
         per_shard_frames = []
         for t in tasks:
@@ -785,7 +828,7 @@ class MeshExecutor:
         cols, counts_arr = shuffle_mod.shard_columns(
             self.mesh, per_shard_cols, counts, capacity
         )
-        return cols, counts_arr, capacity
+        return cols, counts_arr, capacity, False
 
     def _stages_for(self, task: Task) -> List[tuple]:
         """Flatten the chain (innermost→outermost) + output partitioner
@@ -839,11 +882,14 @@ class MeshExecutor:
         return stages
 
     def _program(self, task: Task, caps: Tuple[int, ...],
-                 slack: float = 2.0):
+                 slack: float = 2.0,
+                 subids: Tuple[bool, ...] = ()):
         stages = self._stages_for(task)
+        if not subids:
+            subids = tuple(False for _ in caps)
         key = (tuple((k, sid) for k, sid, _ in stages), caps,
                task.num_partition, len(task.schema),
-               self._input_ncols(task), slack)
+               self._input_ncols(task), slack, subids)
         # The key embeds id()s of stage functions, which can recycle after
         # GC; weakrefs to the actual function objects guard each entry
         # (the jitutil._VMAP_CACHE pattern) — a recycled id recompiles
@@ -872,14 +918,25 @@ class MeshExecutor:
         n_extras = sum(
             len(s.args) for kind, _, s in stages if kind == "map"
         )
-        in_ncols = self._input_ncols(task)
+        # Wave-partitioned (subid-carrying) inputs have one extra
+        # leading int32 column the prelude filters on and strips.
+        in_ncols = tuple(
+            nc + (1 if has_sub else 0)
+            for nc, has_sub in zip(self._input_ncols(task), subids)
+        )
         n_inputs = len(in_ncols)
+        # Likewise the output carries a subid column when this group's
+        # own shuffle routes more partitions than the mesh has devices.
+        out_subid = (task.num_partition > nmesh
+                     if any(k == "shuffle" for k, _, _ in stages)
+                     else False)
 
         # Map-only chains never touch the mask; their final compaction
         # would be an identity permutation — skip it at trace time.
-        mask_dirty = any(k != "map" for k, _, _ in stages)
+        mask_dirty = (any(k != "map" for k, _, _ in stages)
+                      or any(subids))
 
-        def join_prelude(s, counts_list, col_sets):
+        def join_prelude(s, masks, col_sets):
             """The two-input join stage: finish each side's keyed
             reduction (per-device = global per key, since the producer
             shuffles routed equal keys here), then align with the shared
@@ -890,48 +947,52 @@ class MeshExecutor:
             fcA, fcB = s.frame_combiners
             nk = s.prefix
             colsA, colsB = col_sets
-            nA, nB = counts_list[0][0], counts_list[1][0]
-            sizeA, sizeB = colsA[0].shape[0], colsB[0].shape[0]
-            maskA = jnp.arange(sizeA, dtype=np.int32) < nA
-            maskB = jnp.arange(sizeB, dtype=np.int32) < nB
             coreA = segment.make_segmented_reduce_masked(
                 nk, fcA.nvals, segment.canonical_combine(fcA.fn, fcA.nvals)
             )
             coreB = segment.make_segmented_reduce_masked(
                 nk, fcB.nvals, segment.canonical_combine(fcB.fn, fcB.nvals)
             )
-            keepA, kA, vA = coreA(maskA, tuple(colsA[:nk]),
+            keepA, kA, vA = coreA(masks[0], tuple(colsA[:nk]),
                                   tuple(colsA[nk:]))
-            keepB, kB, vB = coreB(maskB, tuple(colsB[:nk]),
+            keepB, kB, vB = coreB(masks[1], tuple(colsB[:nk]),
                                   tuple(colsB[nk:]))
             align = make_align(nk, fcA.nvals, fcB.nvals)
             return align(keepA, kA, vA, keepB, kB, vB)
 
-        def stepped(*counts_cols_extras):
+        def stepped(wave, *counts_cols_extras):
             # Mask-chained stages: validity rides as a bool mask between
             # stages (no per-stage compaction sorts — filters and
             # combiners just update the mask); one final compaction sort
-            # establishes the front-packed output contract.
+            # establishes the front-packed output contract. `wave` is
+            # this launch's consumer-wave index: subid-carrying inputs
+            # keep only their own wave's partition rows.
             counts_list = counts_cols_extras[:n_inputs]
             flat = counts_cols_extras[n_inputs:]
             col_sets = []
+            masks = []
             off = 0
-            for nc in in_ncols:
-                col_sets.append(list(flat[off : off + nc]))
+            for i, nc in enumerate(in_ncols):
+                cset = list(flat[off : off + nc])
                 off += nc
+                n_i = counts_list[i][0]
+                size_i = cset[0].shape[0]
+                m = jnp.arange(size_i, dtype=np.int32) < n_i
+                if subids[i]:
+                    m = m & (cset[0] == wave)
+                    cset = cset[1:]  # strip the subid column
+                col_sets.append(cset)
+                masks.append(m)
             extras = list(flat[off:])
             overflow = jnp.int32(0)
             badrange = jnp.int32(0)
             run_stages = stages
             if stages and stages[0][0] == "join":
-                mask, cols = join_prelude(stages[0][2], counts_list,
-                                          col_sets)
+                mask, cols = join_prelude(stages[0][2], masks, col_sets)
                 run_stages = stages[1:]
             else:
-                n = counts_list[0][0]
                 cols = col_sets[0]
-                size = cols[0].shape[0]
-                mask = jnp.arange(size, dtype=np.int32) < n
+                mask = masks[0]
             for kind, _, s in run_stages:
                 if kind == "map":
                     nargs = len(s.args)
@@ -1029,10 +1090,11 @@ class MeshExecutor:
             out_n, cols = segment.compact_by_mask(mask, cols)
             return (out_n.reshape(1), overflow, badrange, tuple(cols))
 
-        ncols_out = len(task.schema)
+        ncols_out = len(task.schema) + (1 if out_subid else 0)
         col_spec = P(axis)
         in_specs = (
-            tuple(P(axis) for _ in range(n_inputs))
+            (P(),)  # wave scalar (replicated)
+            + tuple(P(axis) for _ in range(n_inputs))
             + tuple(col_spec for _ in range(sum(in_ncols)))
             + tuple(P() for _ in range(n_extras))
         )
@@ -1123,7 +1185,16 @@ class MeshExecutor:
             # consumers.
             if shard != 0:
                 return []
-            cols = [c[partition] for c in chunks]
+            if out.subid:
+                # Wave-partitioned: device p % nmesh holds partition p
+                # where the leading subid column == p // nmesh.
+                dev = partition % self.nmesh
+                sub = partition // self.nmesh
+                dev_cols = [c[dev] for c in chunks]
+                sel = np.asarray(dev_cols[0]) == sub
+                cols = [np.asarray(c)[sel] for c in dev_cols[1:]]
+            else:
+                cols = [c[partition] for c in chunks]
         else:
             if partition != 0:
                 return []

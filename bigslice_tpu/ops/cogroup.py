@@ -107,7 +107,13 @@ class Cogroup(Slice):
 
 class _Cursor:
     """Buffered cursor over a key-sorted frame stream: exposes the current
-    key and extracts whole groups (which may span frame boundaries)."""
+    key and extracts whole groups (which may span frame boundaries).
+
+    Group boundaries are computed ONCE per frame with a vectorized
+    adjacent-row key diff (O(n) per frame, any key types including
+    object cells — elementwise != on shifted object arrays), replacing
+    the round-1 per-row Python tuple compare; streams stay
+    bounded-memory (one frame resident per dep)."""
 
     def __init__(self, reader, nk: int, nvals: int):
         self.reader = reader
@@ -115,6 +121,8 @@ class _Cursor:
         self.nvals = nvals
         self.frame = None
         self.i = 0
+        self._starts = None   # run-start row indices of current frame
+        self._run = 0         # index into _starts of the current run
         self._advance_frame()
 
     def _advance_frame(self):
@@ -122,6 +130,17 @@ class _Cursor:
             if len(f):
                 self.frame = f.to_host()
                 self.i = 0
+                n = len(f)
+                diff = np.zeros(n, dtype=bool)
+                diff[0] = True
+                for c in self.frame.cols[: self.nk]:
+                    a = np.asarray(c)
+                    # Object arrays compare cell-by-cell (tuples/lists
+                    # included) — both operands are object arrays, so
+                    # no broadcasting into cell contents.
+                    diff[1:] |= np.asarray(a[1:] != a[:-1], dtype=bool)
+                self._starts = np.flatnonzero(diff)
+                self._run = 0
                 return
         self.frame = None
 
@@ -137,16 +156,16 @@ class _Cursor:
         while self.frame is not None and self.key() == key:
             f, start = self.frame, self.i
             n = len(f)
-            end = start
-            while end < n and tuple(
-                c[end] for c in f.cols[: self.nk]
-            ) == key:
-                end += 1
+            end = (
+                int(self._starts[self._run + 1])
+                if self._run + 1 < len(self._starts) else n
+            )
             if groups is None:
                 groups = [[] for _ in range(f.num_cols - self.nk)]
             for j, c in enumerate(f.cols[self.nk :]):
                 groups[j].extend(c[start:end])
             self.i = end
+            self._run += 1
             if self.i >= n:
                 self._advance_frame()
         if groups is None:

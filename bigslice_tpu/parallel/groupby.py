@@ -36,54 +36,24 @@ class DeviceGroupByKey:
     def __init__(self, nkeys: int, capacity: int):
         import jax
         import jax.numpy as jnp
-        from jax import lax
 
         self.nkeys = nkeys
         self.capacity = capacity
-        G = capacity
+        core = make_group_by_key_masked(nkeys, capacity)
 
         def kernel(n, *cols):
-            from bigslice_tpu.parallel.segment import (
-                compact_by_mask,
-                sort_and_segment,
-            )
+            from bigslice_tpu.parallel.segment import compact_by_mask
 
-            keys = cols[:nkeys]
-            val = cols[nkeys]
-            size = val.shape[0]
+            size = cols[0].shape[0]
             mask = jnp.arange(size, dtype=np.int32) < n
-            s_invalid, s_keys, (s_val,), diff = sort_and_segment(
-                nkeys, mask, keys, (val,)
+            is_head, keys, groups_row, counts_row = core(
+                mask, tuple(cols[:nkeys]), cols[nkeys]
             )
-            valid_row = (s_invalid == 0)
-
-            idx = jnp.arange(size, dtype=np.int32)
-            is_seg_first = diff & valid_row
             n_groups, packed = compact_by_mask(
-                is_seg_first, (idx,) + tuple(s_keys)
+                is_head, tuple(keys) + (groups_row, counts_row)
             )
-            first_idx = packed[0]
-            out_keys = packed[1:]
-
-            # Rows of a segment are contiguous post-sort: gather a [k, G]
-            # window starting at each segment head (clipped), masked by
-            # the true segment length — no O(size*G) scatter matrices.
-            seg_len_all = jnp.zeros((size + 1,), np.int32).at[
-                jnp.where(valid_row,
-                          jnp.cumsum(diff.astype(np.int32)) - 1, size)
-            ].add(1, mode="drop")[:size]
-            seg_id_of_first = jnp.cumsum(diff.astype(np.int32))[first_idx] - 1
-            out_counts = seg_len_all[seg_id_of_first]
-            offsets = jnp.minimum(
-                first_idx[:, None] + jnp.arange(G, dtype=np.int32)[None, :],
-                size - 1,
-            )
-            gathered = s_val[offsets]
-            in_group = (jnp.arange(G, dtype=np.int32)[None, :]
-                        < jnp.minimum(out_counts, G)[:, None])
-            out_groups = jnp.where(in_group, gathered,
-                                   jnp.zeros((), val.dtype))
-            return n_groups, out_keys, out_groups, out_counts
+            return (n_groups, packed[:nkeys], packed[nkeys],
+                    packed[nkeys + 1])
 
         self._jitted = jax.jit(kernel)
 

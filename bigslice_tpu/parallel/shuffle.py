@@ -59,8 +59,15 @@ def make_shuffle_fn(nshards: int, nkeys: int, capacity: int,
     ``nparts`` (default ``nshards``) is the partition count the routing
     modulo uses — it may be smaller than the mesh (padded-mesh groups:
     a 5-shard op on an 8-device mesh routes to partitions 0..4 and
-    devices 5..7 receive nothing). It must agree with the host tier's
+    devices 5..7 receive nothing) or LARGER (wave-partitioned outputs:
+    partition p routes to device ``p % nshards`` carrying a subid
+    ``p // nshards`` as an extra leading output column, which waved
+    consumers filter on). It must agree with the host tier's
     ``hash % nparts`` so mixed-tier dep edges stay consistent.
+
+    With ``nparts > nshards`` the returned ``out_cols`` carry the int32
+    subid column FIRST (callers drop or filter it); capacity per device
+    grows to hold every subid's rows.
     """
     import jax.numpy as jnp
     from jax import lax
@@ -69,8 +76,13 @@ def make_shuffle_fn(nshards: int, nkeys: int, capacity: int,
 
     if nparts is None:
         nparts = nshards
-    assert nparts <= nshards, (nparts, nshards)
-    send_cap = send_capacity(capacity, nparts, slack)
+    waved = nparts > nshards
+    # Destinations per device lane: one partition each when nparts fits
+    # the mesh; W partitions share a device (distinguished by subid)
+    # when it doesn't — per-device send volume scales accordingly.
+    send_cap = send_capacity(
+        capacity, nshards if waved else nparts, slack
+    )
 
     def body_masked(valid, *cols):
         """Mask-based core: rows where ``valid`` route; returns
@@ -120,6 +132,19 @@ def make_shuffle_fn(nshards: int, nkeys: int, capacity: int,
             jnp.int32(0) if bad is None
             else (bad & valid).sum().astype(np.int32)
         )
+        if waved:
+            # Device lane + subid: rows carry subid = p // nshards as
+            # an extra leading payload column, for waved consumers to
+            # filter their own partition post-exchange.
+            dev = jnp.where(part < nparts, part % np.int32(nshards),
+                            np.int32(nshards))
+            subid = jnp.where(part < nparts,
+                              part // np.int32(nshards), np.int32(0))
+            cols = (subid.astype(np.int32),) + tuple(cols)
+            part = dev
+            ndest = nshards
+        else:
+            ndest = nparts
 
         # Sort rows by destination; payload rides along.
         sorted_ops = lax.sort((part,) + tuple(cols), num_keys=1,
@@ -128,22 +153,24 @@ def make_shuffle_fn(nshards: int, nkeys: int, capacity: int,
         s_cols = sorted_ops[1:]
 
         # Row counts per destination and bucket-local offsets (the
-        # fused kernel already produced them on the pallas path).
+        # fused kernel already produced them on the pallas path; waved
+        # routing re-derives per-DEVICE counts from the sorted lanes).
         counts = (
-            kernel_counts if kernel_counts is not None
-            else jnp.bincount(s_part, length=nparts + 1)[:nparts]
+            kernel_counts
+            if kernel_counts is not None and not waved
+            else jnp.bincount(s_part, length=ndest + 1)[:ndest]
         )
         starts = jnp.concatenate(
             [jnp.zeros(1, np.int32),
              jnp.cumsum(counts).astype(np.int32)[:-1]]
         )
         offset = jnp.arange(size, dtype=np.int32) - jnp.take(
-            starts, jnp.minimum(s_part, nparts - 1)
+            starts, jnp.minimum(s_part, ndest - 1)
         )
 
         # Scatter into (nshards, send_cap) send buckets; rows beyond
         # capacity (or invalid) drop — reported via `overflow`.
-        in_bounds = (offset < send_cap) & (s_part < nparts)
+        in_bounds = (offset < send_cap) & (s_part < ndest)
         dest_row = jnp.where(in_bounds, s_part, nshards)  # drop lane
         dest_off = jnp.where(in_bounds, offset, 0)
         out_buckets = []
@@ -153,8 +180,8 @@ def make_shuffle_fn(nshards: int, nkeys: int, capacity: int,
             out_buckets.append(buf[:nshards])
         send_counts = jnp.concatenate([
             jnp.minimum(counts, send_cap).astype(np.int32),
-            jnp.zeros(nshards - nparts, np.int32),
-        ]) if nparts < nshards else jnp.minimum(
+            jnp.zeros(nshards - ndest, np.int32),
+        ]) if ndest < nshards else jnp.minimum(
             counts, send_cap
         ).astype(np.int32)
 

@@ -163,8 +163,117 @@ def worker(num_processes: int, process_id: int, port: int,
     return 0
 
 
+def chaos_worker(num_processes: int, process_id: int, port: int) -> int:
+    """Host-loss chaos (SURVEY §5.3's fault-injection idea at the
+    process level): a full SPMD session runs healthy, then one peer
+    dies abruptly; the survivor's next run must fail FAST with a
+    classified HostLostError — not hang in a collective."""
+    from bigslice_tpu.utils.hermetic import force_hermetic_cpu
+
+    force_hermetic_cpu()
+    import numpy as np
+
+    from bigslice_tpu.utils import distributed
+
+    distributed.initialize(
+        coordinator=f"127.0.0.1:{port}",
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    import bigslice_tpu as bs
+    from bigslice_tpu.exec import spmd as spmd_mod
+    from bigslice_tpu.exec.meshexec import HostLostError
+    from bigslice_tpu.exec.task import TaskError
+
+    mesh = distributed.global_mesh()
+    n = int(mesh.devices.size)
+    sess = spmd_mod.spmd_session(mesh)
+
+    def add(a, b):
+        return a + b
+
+    keys = np.arange(n * 16, dtype=np.int32) % 5
+    red = bs.Reduce(bs.Const(n, keys, np.ones(len(keys), np.int32)), add)
+    assert dict(sess.run(red).rows()) == {i: n * 16 // 5 + (
+        1 if i < (n * 16) % 5 else 0) for i in range(5)}
+
+    if process_id == 1:
+        print("CHAOS: process 1 dying abruptly", flush=True)
+        os._exit(1)
+
+    import time
+
+    t0 = time.time()
+    try:
+        sess.run(bs.Reduce(
+            bs.Const(n, keys, np.ones(len(keys), np.int32)), add
+        ))
+        print("CHAOS_FAIL: second run succeeded with a dead peer",
+              flush=True)
+        os._exit(1)
+    except TaskError as e:
+        took = time.time() - t0
+        ok = isinstance(e.cause, HostLostError) and took < 60
+        print(f"CHAOS_{'OK' if ok else 'FAIL'}: "
+              f"{type(e.cause).__name__} after {took:.1f}s", flush=True)
+        os._exit(0 if ok else 1)
+
+
 def main(argv=None) -> int:
     argv = argv if argv is not None else sys.argv[1:]
+    if argv and argv[0] == "--chaos-worker":
+        return chaos_worker(int(argv[1]), int(argv[2]), int(argv[3]))
+    if argv and argv[0] == "--chaos":
+        import tempfile
+
+        port = _free_port()
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        cap = tempfile.TemporaryFile(mode="w+")
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-m",
+                 "bigslice_tpu.tools.multihost_smoke",
+                 "--chaos-worker", "2", str(i), str(port)],
+                env=env,
+                stdout=cap if i == 0 else None,
+                stderr=cap if i == 0 else None,
+            )
+            for i in (0, 1)
+        ]
+        # Process 1 exits 1 by design (the chaos); process 0 carries
+        # the verdict. Two legitimate fast-failure shapes:
+        # (a) the collective errors first → our classified
+        #     HostLostError (CHAOS_OK), or
+        # (b) the jax coordination service's heartbeat detection kills
+        #     the survivor with a fatal "another task died" report —
+        #     the platform's own host-loss detector doing the job.
+        # A hang (timeout) is the only failure.
+        rc = 1
+        try:
+            p0rc = procs[0].wait(timeout=150)
+            cap.seek(0)
+            text = cap.read()
+            if p0rc == 0 and "CHAOS_OK" in text:
+                print("CHAOS_OK: classified HostLostError", flush=True)
+                rc = 0
+            elif ("detected fatal errors" in text
+                  or "stopped sending heartbeats" in text):
+                print("CHAOS_OK: coordination-service heartbeat "
+                      "detection terminated the survivor", flush=True)
+                rc = 0
+            else:
+                print(f"CHAOS_FAIL: rc={p0rc}\n{text[-1500:]}",
+                      flush=True)
+        except subprocess.TimeoutExpired:
+            print("CHAOS_FAIL: survivor hung past 150s", flush=True)
+        finally:
+            for p in procs:
+                try:
+                    p.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+        sys.exit(rc)
     if argv and argv[0] == "--worker":
         return worker(int(argv[1]), int(argv[2]), int(argv[3]))
     nproc = int(argv[0]) if argv else 2

@@ -25,3 +25,19 @@ def test_two_process_distributed_smoke():
     # group launch → collective execution → result scan) across the
     # two processes with the device path engaged.
     assert "MULTIHOST_SESSION_OK" in out.stdout
+
+
+def test_host_loss_surfaces_fast():
+    """A peer dying mid-session fails the survivor's next run FAST with
+    a classified HostLostError (the gang-scheduled analog of machine
+    loss, SURVEY §5.3) — never a hang in a collective."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, "-m", "bigslice_tpu.tools.multihost_smoke",
+         "--chaos"],
+        capture_output=True, text=True, timeout=240, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert out.returncode == 0, (out.stdout[-1500:], out.stderr[-1500:])
+    assert "CHAOS_OK" in out.stdout
