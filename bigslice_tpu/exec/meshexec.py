@@ -63,11 +63,14 @@ class HostLostError(RuntimeError):
     recomputation of finished stages."""
 
 
-# Multi-word markers only: a user error merely *mentioning* "peer" or
-# "distributed" must not be rewrapped with restart-the-fleet advice.
+# Multi-word, runtime-specific markers only: a user error merely
+# *mentioning* "peer"/"preempt"/"distributed" must not be rewrapped
+# with restart-the-fleet advice.
 _DIST_ERR_MARKERS = (
-    "gloo", "connection reset", "coordination service",
-    "stopped sending heartbeats", "preempt",
+    "gloo allgather failed", "gloo allreduce failed",
+    "gloo alltoall failed", "connection reset by peer",
+    "coordination service", "stopped sending heartbeats",
+    "worker was preempted",
     "distributed service detected fatal errors",
 )
 

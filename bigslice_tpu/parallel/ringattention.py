@@ -1,0 +1,108 @@
+"""Ring attention: sequence parallelism over the device mesh.
+
+The reference has no sequence/attention machinery (SURVEY.md §5.7 —
+its "long context" story is external sort + shuffle on unbounded keyed
+records). SURVEY notes that if sequence parallelism were added it would
+occupy the same architectural slot as Reduce's combiner lowering:
+a collective-structured kernel over the 1-D mesh. This module is that
+kernel — long-context attention where the sequence dimension is sharded
+across devices and K/V blocks ROTATE around the ring (`lax.ppermute`
+over ICI) while each device accumulates its queries' output with an
+online (flash-style) softmax:
+
+    per step:  scores = Q_local @ K_blk^T
+               rescale running (max, denom, acc) — numerically exact
+               K/V blocks advance one hop around the ring
+
+After nmesh steps every query block has attended to the full global
+sequence with only O(seq/nmesh) resident keys per device and pure
+neighbor communication (the all-to-all-free formulation; ring attention
+a la Liu et al., blockwise-parallel transformers — public recipe).
+
+This composes with the framework's data plane: a [n, d] sequence rides
+as d scalar columns or one vector column of a Frame, sharded on the
+mesh exactly like shuffle inputs (shard_columns).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from bigslice_tpu.parallel.meshutil import get_shard_map, mesh_axis
+
+
+def make_ring_attention(mesh, d: int, causal: bool = False,
+                        dtype=np.float32):
+    """Build a jitted ring-attention forward over a 1-D mesh.
+
+    Returns ``fn(q, k, v) -> out`` on GLOBAL arrays of shape
+    [seq, d], row-sharded over the mesh (seq % nmesh == 0). ``causal``
+    masks by global positions (block offsets ride the ring step).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    axis = mesh_axis(mesh)
+    nmesh = int(mesh.devices.size)
+    shard_map = get_shard_map()
+    scale = 1.0 / np.sqrt(d)
+    neg_inf = np.array(-1e30, dtype)
+
+    def local(q, k, v):
+        n_local = q.shape[0]
+        my_blk = lax.axis_index(axis)
+        rows = my_blk * n_local + jnp.arange(n_local, dtype=np.int32)
+        perm = [(j, (j + 1) % nmesh) for j in range(nmesh)]
+
+        def step(i, carry):
+            k_blk, v_blk, acc, m, l = carry
+            # K/V block currently held arrived from device
+            # (my_blk - i) mod nmesh — its global column offset.
+            src = (my_blk - i) % nmesh
+            cols = src * n_local + jnp.arange(n_local, dtype=np.int32)
+            s = (q @ k_blk.T) * scale  # [n_local, n_local]
+            if causal:
+                s = jnp.where(cols[None, :] <= rows[:, None], s,
+                              neg_inf)
+            m_new = jnp.maximum(m, s.max(axis=-1))
+            p = jnp.exp(s - m_new[:, None])
+            corr = jnp.exp(m - m_new)
+            l_new = l * corr + p.sum(axis=-1)
+            acc_new = acc * corr[:, None] + p @ v_blk
+            # Rotate K/V one hop around the ring.
+            k_next = lax.ppermute(k_blk, axis, perm)
+            v_next = lax.ppermute(v_blk, axis, perm)
+            return k_next, v_next, acc_new, m_new, l_new
+
+        acc0 = jnp.zeros((n_local, d), dtype)
+        m0 = jnp.full((n_local,), neg_inf, dtype)
+        l0 = jnp.zeros((n_local,), dtype)
+        k_f, v_f, acc, m, l = lax.fori_loop(
+            0, nmesh, step, (k, v, acc0, m0, l0)
+        )
+        # Fully-masked rows (can't happen causally: every row sees
+        # itself) would divide by zero; guard anyway.
+        return acc / jnp.maximum(l, 1e-30)[:, None]
+
+    spec = P(axis)
+    return jax.jit(shard_map(
+        local, mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_rep=False,
+    ))
+
+
+def dense_attention_reference(q, k, v, causal: bool = False):
+    """Host oracle for tests: materialized softmax(QK^T/sqrt(d))V."""
+    q, k, v = (np.asarray(x, np.float64) for x in (q, k, v))
+    s = (q @ k.T) / np.sqrt(q.shape[1])
+    if causal:
+        n = s.shape[0]
+        s = np.where(np.tril(np.ones((n, n), bool)), s, -np.inf)
+    s = s - s.max(axis=-1, keepdims=True)
+    p = np.exp(s)
+    p = p / p.sum(axis=-1, keepdims=True)
+    return p @ v

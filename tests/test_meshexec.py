@@ -116,11 +116,11 @@ def test_small_shard_count_runs_padded(mesh):
     assert sess.executor.device_group_count() >= 2
 
 
-def test_large_shard_count_mixed_tiers(mesh):
+def test_large_shard_count_full_device(mesh):
     sess = Session(executor=MeshExecutor(mesh))
-    # 11 shards exceed the 8-device mesh: the 11-PARTITION shuffle
-    # producer falls back (partition counts must fit the mesh), but the
-    # 11-shard reduce consumer itself runs on the device in two waves.
+    # 11 shards exceed the 8-device mesh: the 11-partition producer
+    # shuffles through the subid lane and the 11-shard reduce consumer
+    # runs in two waves — BOTH groups device-resident.
     r = bs.Reduce(
         bs.Const(11, np.arange(110, dtype=np.int32) % 7,
                  np.ones(110, dtype=np.int32)),
@@ -129,7 +129,7 @@ def test_large_shard_count_mixed_tiers(mesh):
     res = sess.run(r)
     assert dict(res.rows()) == {i: 110 // 7 + (1 if i < 110 % 7 else 0)
                                 for i in range(7)}
-    assert sess.executor.device_group_count() >= 1
+    assert sess.executor.device_group_count() >= 2
 
 
 def test_result_reuse_across_runs(sess):

@@ -1,0 +1,52 @@
+"""Ring attention (sequence parallelism over the mesh) vs the dense
+oracle — full and causal, on the 8-device virtual mesh."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from bigslice_tpu.parallel import ringattention as ra
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(jax.devices()[:8]), ("shards",))
+
+
+def _qkv(seq, d, seed=0):
+    rng = np.random.RandomState(seed)
+    return (rng.randn(seq, d).astype(np.float32) * 0.3,
+            rng.randn(seq, d).astype(np.float32) * 0.3,
+            rng.randn(seq, d).astype(np.float32))
+
+
+def _global(mesh, x):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return jax.device_put(x, NamedSharding(mesh, P("shards")))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_dense(mesh, causal):
+    seq, d = 8 * 16, 8
+    q, k, v = _qkv(seq, d, seed=3 + causal)
+    fn = ra.make_ring_attention(mesh, d=d, causal=causal)
+    out = np.asarray(fn(_global(mesh, q), _global(mesh, k),
+                        _global(mesh, v)))
+    ref = ra.dense_attention_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_ring_attention_long_sequence_streams(mesh):
+    """Longer-than-one-block sequences: each device holds seq/8 keys at
+    a time; accumulation over the ring is exact."""
+    seq, d = 8 * 64, 16
+    q, k, v = _qkv(seq, d, seed=11)
+    fn = ra.make_ring_attention(mesh, d=d, causal=True)
+    out = np.asarray(fn(_global(mesh, q), _global(mesh, k),
+                        _global(mesh, v)))
+    ref = ra.dense_attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(out, ref, rtol=3e-4, atol=3e-4)
