@@ -205,6 +205,9 @@ class MeshExecutor:
         self._outputs: Dict[Tuple, DeviceGroupOutput] = {}
         self._task_index: Dict[TaskName, Tuple[Tuple, Task]] = {}
         self._programs: Dict[Tuple, Tuple[object, list]] = {}
+        # Adapted shuffle slack per op (see _execute_wave): overflow
+        # probes run once per op, not once per wave/run.
+        self._slack_memo: Dict[str, float] = {}
         # Ordered dispatch: ONE dispatcher thread launches device groups
         # strictly in the compile-time plan order the session registers
         # (deterministic by construction — the issue-order discipline
@@ -643,7 +646,20 @@ class MeshExecutor:
         # This is the recompile-averse bucketing strategy from SURVEY.md
         # §7.3(1)/(5) — a bounded set of compiled programs, no dynamic
         # shapes.
-        slack = 2.0
+        #
+        # Combiner-bearing shuffles start at slack 1.0: map-side
+        # combining bounds each destination's load by the shard's
+        # distinct-key count, typically well under capacity — and the
+        # receive buffer (slack × capacity rows) is what the reduce-side
+        # combine must sort, the pipeline's single largest pass
+        # (BASELINE.md roofline). Low-reduction data overflows once,
+        # retries bigger, and the adapted slack is remembered per op so
+        # the probe cost is paid once per session, not per wave/run.
+        has_combiner = (task0.num_partition > 1
+                        and task0.partitioner.combiner is not None)
+        slack = self._slack_memo.get(
+            task0.name.op, 1.0 if has_combiner else 2.0
+        )
         # Wave-partitioned output: more partitions than devices → the
         # shuffle routes per device with a subid payload column.
         out_subid = task0.num_partition > self.nmesh
@@ -680,6 +696,7 @@ class MeshExecutor:
                     f"even at full slack"
                 )
             slack = min(slack * 4, full_slack)
+            self._slack_memo[task0.name.op] = slack
         out_capacity = (
             self.nmesh
             * shuffle_mod.send_capacity(base_capacity, ndest, slack)
@@ -1062,26 +1079,39 @@ class MeshExecutor:
                     part = s.partitioner
                     fc = part.combiner
                     nkeys = s.schema.prefix
-                    if fc is not None:
-                        core = segment.make_segmented_reduce_masked(
-                            fc.nkeys, fc.nvals,
-                            segment.canonical_combine(fc.fn, fc.nvals),
-                        )
-                        mask, keys, vals = core(
-                            mask, tuple(cols[: fc.nkeys]),
-                            tuple(cols[fc.nkeys :]),
-                        )
-                        cols = list(keys) + list(vals)
                     pf = part.partition_fn
-                    body = shuffle_mod.make_shuffle_fn(
-                        nmesh, nkeys, cols[0].shape[0], axis,
-                        slack=slack, nparts=s.num_partition,
-                        partition_fn=(
-                            pf.device_fn(s.num_partition)
-                            if pf is not None else None
-                        ),
-                    )
-                    mask, ov, nb, cols = body.masked(mask, *cols)
+                    pfn = (pf.device_fn(s.num_partition)
+                           if pf is not None else None)
+                    if fc is not None and fc.nkeys == nkeys:
+                        # Combiner-bearing shuffle: the fused kernel's
+                        # single (validity, dest, keys) sort replaces
+                        # the combine sort + routing sort pair.
+                        body = shuffle_mod.make_combine_shuffle_fn(
+                            nmesh, fc.nkeys, fc.nvals,
+                            segment.canonical_combine(fc.fn, fc.nvals),
+                            axis, slack=slack,
+                            nparts=s.num_partition, partition_fn=pfn,
+                        )
+                        mask, ov, nb, cols = body.masked(mask, *cols)
+                    else:
+                        if fc is not None:
+                            core = segment.make_segmented_reduce_masked(
+                                fc.nkeys, fc.nvals,
+                                segment.canonical_combine(
+                                    fc.fn, fc.nvals
+                                ),
+                            )
+                            mask, keys, vals = core(
+                                mask, tuple(cols[: fc.nkeys]),
+                                tuple(cols[fc.nkeys :]),
+                            )
+                            cols = list(keys) + list(vals)
+                        body = shuffle_mod.make_shuffle_fn(
+                            nmesh, nkeys, cols[0].shape[0], axis,
+                            slack=slack, nparts=s.num_partition,
+                            partition_fn=pfn,
+                        )
+                        mask, ov, nb, cols = body.masked(mask, *cols)
                     cols = list(cols)
                     overflow = overflow + ov
                     badrange = badrange + nb

@@ -56,8 +56,13 @@ def make_ring_attention(mesh, d: int, causal: bool = False,
         rows = my_blk * n_local + jnp.arange(n_local, dtype=np.int32)
         perm = [(j, (j + 1) % nmesh) for j in range(nmesh)]
 
-        def step(i, carry):
-            k_blk, v_blk, acc, m, l = carry
+        acc = jnp.zeros((n_local, d), dtype)
+        m = jnp.full((n_local,), neg_inf, dtype)
+        l = jnp.zeros((n_local,), dtype)
+        k_blk, v_blk = k, v
+        # Unrolled over the (static) ring length: XLA sees every hop and
+        # can overlap each ppermute with the previous block's matmuls.
+        for i in range(nmesh):
             # K/V block currently held arrived from device
             # (my_blk - i) mod nmesh — its global column offset.
             src = (my_blk - i) % nmesh
@@ -69,19 +74,16 @@ def make_ring_attention(mesh, d: int, causal: bool = False,
             m_new = jnp.maximum(m, s.max(axis=-1))
             p = jnp.exp(s - m_new[:, None])
             corr = jnp.exp(m - m_new)
-            l_new = l * corr + p.sum(axis=-1)
-            acc_new = acc * corr[:, None] + p @ v_blk
-            # Rotate K/V one hop around the ring.
-            k_next = lax.ppermute(k_blk, axis, perm)
-            v_next = lax.ppermute(v_blk, axis, perm)
-            return k_next, v_next, acc_new, m_new, l_new
-
-        acc0 = jnp.zeros((n_local, d), dtype)
-        m0 = jnp.full((n_local,), neg_inf, dtype)
-        l0 = jnp.zeros((n_local,), dtype)
-        k_f, v_f, acc, m, l = lax.fori_loop(
-            0, nmesh, step, (k, v, acc0, m0, l0)
-        )
+            l = l * corr + p.sum(axis=-1)
+            acc = acc * corr[:, None] + p @ v_blk
+            m = m_new
+            # Rotate K/V one hop around the ring — skipped on the last
+            # step (every block is accumulated; the hop's result would
+            # be discarded, and ppermute is a blocking neighbor
+            # collective on the critical path).
+            if i < nmesh - 1:
+                k_blk = lax.ppermute(k_blk, axis, perm)
+                v_blk = lax.ppermute(v_blk, axis, perm)
         # Fully-masked rows (can't happen causally: every row sees
         # itself) would divide by zero; guard anyway.
         return acc / jnp.maximum(l, 1e-30)[:, None]

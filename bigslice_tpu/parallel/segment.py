@@ -68,22 +68,51 @@ def compact_by_mask(mask, cols):
     relative order of survivors). Returns (count, cols). The one shared
     implementation of the capacity+validity → front-packed conversion.
 
-    Scalar columns ride ``lax.sort`` directly; vector columns (trailing
-    dims — GroupByKey matrices) can't be sort operands, so mixed
-    column sets compact via a sorted permutation + gather instead."""
+    A survivor's packed position is its survivor rank (exclusive cumsum
+    of the mask), so compaction is one cumsum + one scatter per column
+    — NOT a sort: on the sort-dominated roofline (BASELINE.md) this
+    pass was costing as much as the keyed combine it followed. Dropped
+    rows scatter to the out-of-range drop lane; the vacated tail reads
+    as zeros (callers slice to ``count``)."""
+    import jax.numpy as jnp
+
+    cols = tuple(cols)
+    size = cols[0].shape[0]
+    keep = mask.astype(np.int32)
+    rank = jnp.cumsum(keep).astype(np.int32) - keep
+    dest = jnp.where(mask, rank, np.int32(size))  # size = drop lane
+    out = []
+    for c in cols:
+        buf = jnp.zeros(c.shape, c.dtype)
+        buf = buf.at[dest].set(c, mode="drop")
+        out.append(buf)
+    return mask.sum().astype(np.int32), tuple(out)
+
+
+def segmented_combine(diff, s_vals, cfn):
+    """Apply an associative combine within each segment of sorted rows.
+
+    ``diff`` marks segment starts; returns ``(is_last, reduced)`` where
+    ``is_last`` marks each segment's final row (which holds the full
+    segment reduction in ``reduced``). Shared by the standalone reduce
+    core and the fused combine+shuffle kernel (parallel/shuffle.py).
+    """
     import jax.numpy as jnp
     from jax import lax
 
-    inv = (~mask).astype(np.int32)
-    cols = tuple(cols)
-    if any(getattr(c, "ndim", 1) > 1 for c in cols):
-        size = cols[0].shape[0]
-        iota = jnp.arange(size, dtype=np.int32)
-        _, perm = lax.sort((inv, iota), num_keys=1, is_stable=True)
-        return (mask.sum().astype(np.int32),
-                tuple(jnp.take(c, perm, axis=0) for c in cols))
-    packed = lax.sort((inv,) + cols, num_keys=1, is_stable=True)
-    return mask.sum().astype(np.int32), tuple(packed[1:])
+    size = diff.shape[0]
+
+    def scan_op(x, y):
+        fx, vx = x
+        fy, vy = y
+        merged = cfn(vx, vy)
+        return (fx | fy, tuple(
+            jnp.where(fy, b, m) for b, m in zip(vy, merged)
+        ))
+
+    _, red = lax.associative_scan(scan_op, (diff, tuple(s_vals)))
+    is_last = jnp.ones(size, dtype=bool).at[:-1].set(diff[1:])
+    return is_last, tuple(red)
 
 
 def make_segmented_reduce_masked(nkeys: int, nvals: int, cfn,
@@ -98,25 +127,12 @@ def make_segmented_reduce_masked(nkeys: int, nvals: int, cfn,
     rows). With ``compact=True`` it returns ``(count, keys, vals)``
     front-compacted (the output contract).
     """
-    import jax.numpy as jnp
-    from jax import lax
 
     def core(valid_mask, key_cols, val_cols):
-        size = key_cols[0].shape[0]
         s_invalid, s_keys, s_vals, diff = sort_and_segment(
             nkeys, valid_mask, key_cols, val_cols
         )
-
-        def scan_op(x, y):
-            fx, vx = x
-            fy, vy = y
-            merged = cfn(vx, vy)
-            return (fx | fy, tuple(
-                jnp.where(fy, b, m) for b, m in zip(vy, merged)
-            ))
-
-        _, red = lax.associative_scan(scan_op, (diff, tuple(s_vals)))
-        is_last = jnp.ones(size, dtype=bool).at[:-1].set(diff[1:])
+        is_last, red = segmented_combine(diff, s_vals, cfn)
         keep = is_last & (s_invalid == 0)
         if not compact:
             return keep, s_keys, tuple(red)

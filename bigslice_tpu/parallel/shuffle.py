@@ -37,6 +37,93 @@ def send_capacity(capacity: int, nshards: int, slack: float = 2.0) -> int:
     return max(1, int(np.ceil(capacity * slack / nshards)))
 
 
+def partition_ids(keys, nparts: int, seed: int, valid=None,
+                  partition_fn: Optional[Callable] = None,
+                  use_pallas: Optional[bool] = None,
+                  with_counts: bool = False):
+    """Destination partition ids for rows keyed by ``keys`` — THE one
+    implementation of the device tier's routing contract (murmur-style
+    ``hash % nparts``, bit-matching the host tier), shared by the
+    routing-sort shuffle and the fused combine+shuffle so the two can
+    never drift.
+
+    Returns ``(part, bad, counts)``: ids int32[n] with invalid rows
+    (``valid`` False) and out-of-range partitioner ids parked at the
+    ``nparts`` sentinel; ``bad`` the bool mask of out-of-range ids
+    (None under hash routing, which cannot produce them); ``counts``
+    the per-partition histogram of routable rows when ``with_counts``
+    and the fused Pallas kernel served the request, else None.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from bigslice_tpu.frame import ops as frame_ops
+    from bigslice_tpu.parallel import pallas_kernels as pk
+
+    if partition_fn is not None:
+        part = jnp.asarray(partition_fn(*keys)).astype(np.int32)
+        bad = (part < 0) | (part >= nparts)
+        part = jnp.where(bad, np.int32(nparts), part)
+        if valid is not None:
+            part = jnp.where(valid, part, np.int32(nparts))
+        return part, bad, None
+    enable_pallas = use_pallas
+    if enable_pallas is None:
+        # Mosaic-compiled on TPU; on CPU the interpreter is slower
+        # than the fused XLA ops, so default off.
+        enable_pallas = jax.default_backend() == "tpu"
+    if enable_pallas and pk.supports(keys):
+        # Native tier: ONE fused VMEM sweep for murmur hash, combine
+        # chain, validity routing, and (optionally) the destination
+        # histogram. Bit-identical to the XLA path below.
+        part, counts = pk.hash_partition(
+            list(keys), nparts, seed, with_counts=with_counts,
+            valid=valid,
+        )
+        return part, None, counts
+    h = None
+    for k in keys:
+        kh = frame_ops.hash_device_column(k, seed)
+        h = kh if h is None else frame_ops.combine_hashes(h, kh)
+    part = (h % np.uint32(nparts)).astype(np.int32)
+    if valid is not None:
+        part = jnp.where(valid, part, np.int32(nparts))
+    return part, None, None
+
+
+def bucket_exchange(axis: str, nshards: int, send_cap: int, dest_row,
+                    dest_off, send_counts, cols):
+    """Scatter rows into per-destination send buckets and run the two
+    all_to_alls (counts then data). ``dest_row`` is each row's
+    destination device lane (``nshards`` = drop), ``dest_off`` its slot
+    within that bucket, ``send_counts`` int32[nshards] the (clipped)
+    rows per destination. Returns (recv_valid_mask, out_cols) with
+    out_cols holding ``nshards * send_cap`` rows — bucket from each
+    source shard, row j of source bucket s valid iff j < recv_counts[s].
+    Shared by the routing-sort shuffle and the fused combine+shuffle."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    out_buckets = []
+    for c in cols:
+        buf = jnp.zeros((nshards + 1, send_cap) + c.shape[1:], c.dtype)
+        buf = buf.at[dest_row, dest_off].set(c, mode="drop")
+        out_buckets.append(buf[:nshards])
+    recv_counts = lax.all_to_all(
+        send_counts.reshape(nshards, 1), axis, 0, 0, tiled=False
+    ).reshape(nshards)
+    recv = [
+        lax.all_to_all(b, axis, 0, 0, tiled=False)
+        for b in out_buckets
+    ]
+    out_cols = [r.reshape((nshards * send_cap,) + r.shape[2:])
+                for r in recv]
+    row_in_bucket = jnp.arange(send_cap, dtype=np.int32)
+    valid_mask = (row_in_bucket[None, :]
+                  < recv_counts[:, None]).reshape(-1)
+    return valid_mask, out_cols
+
+
 def make_shuffle_fn(nshards: int, nkeys: int, capacity: int,
                     axis: str = "shards", seed: int = 0,
                     partition_fn: Optional[Callable] = None,
@@ -72,8 +159,6 @@ def make_shuffle_fn(nshards: int, nkeys: int, capacity: int,
     import jax.numpy as jnp
     from jax import lax
 
-    from bigslice_tpu.frame import ops as frame_ops
-
     if nparts is None:
         nparts = nshards
     waved = nparts > nshards
@@ -91,43 +176,15 @@ def make_shuffle_fn(nshards: int, nkeys: int, capacity: int,
         masks (segmented reduce) chain without the extra sort."""
         size = cols[0].shape[0]
         keys = cols[:nkeys]
-        kernel_counts = None
-        if partition_fn is not None:
-            part = jnp.asarray(partition_fn(*keys)).astype(np.int32)
-            # Out-of-range ids route to the drop lane and are counted in
-            # the overflow signal rather than silently clipped.
-            bad = (part < 0) | (part >= nparts)
-            part = jnp.where(bad, np.int32(nparts), part)
-            part = jnp.where(valid, part, np.int32(nparts))
-        else:
-            bad = None
-            enable_pallas = use_pallas
-            if enable_pallas is None:
-                import jax
-
-                # Mosaic-compiled on TPU; on CPU the interpreter is
-                # slower than the fused XLA ops, so default off.
-                enable_pallas = jax.default_backend() == "tpu"
-            from bigslice_tpu.parallel import pallas_kernels as pk
-
-            if enable_pallas and pk.supports(keys):
-                # Native tier: ONE fused VMEM sweep for murmur hash,
-                # combine chain, validity routing, AND the destination
-                # histogram — replacing separate hash ops + where +
-                # scatter-lowered bincount. Bit-identical to the XLA
-                # path below.
-                part, kernel_counts = pk.hash_partition(
-                    list(keys), nparts, seed, with_counts=True,
-                    valid=valid,
-                )
-            else:
-                h = None
-                for k in keys:
-                    kh = frame_ops.hash_device_column(k, seed)
-                    h = kh if h is None else frame_ops.combine_hashes(h, kh)
-                part = (h % np.uint32(nparts)).astype(np.int32)
-                # Invalid rows route to a virtual shard that sorts last.
-                part = jnp.where(valid, part, np.int32(nparts))
+        # Out-of-range partitioner ids route to the drop lane and are
+        # counted separately; invalid rows route to a virtual shard
+        # that sorts last. The fused Pallas kernel (when engaged) also
+        # returns the destination histogram, replacing the
+        # scatter-lowered bincount below.
+        part, bad, kernel_counts = partition_ids(
+            keys, nparts, seed, valid=valid, partition_fn=partition_fn,
+            use_pallas=use_pallas, with_counts=True,
+        )
         n_bad = (
             jnp.int32(0) if bad is None
             else (bad & valid).sum().astype(np.int32)
@@ -173,33 +230,16 @@ def make_shuffle_fn(nshards: int, nkeys: int, capacity: int,
         in_bounds = (offset < send_cap) & (s_part < ndest)
         dest_row = jnp.where(in_bounds, s_part, nshards)  # drop lane
         dest_off = jnp.where(in_bounds, offset, 0)
-        out_buckets = []
-        for c in s_cols:
-            buf = jnp.zeros((nshards + 1, send_cap) + c.shape[1:], c.dtype)
-            buf = buf.at[dest_row, dest_off].set(c, mode="drop")
-            out_buckets.append(buf[:nshards])
         send_counts = jnp.concatenate([
             jnp.minimum(counts, send_cap).astype(np.int32),
             jnp.zeros(nshards - ndest, np.int32),
         ]) if ndest < nshards else jnp.minimum(
             counts, send_cap
         ).astype(np.int32)
-
-        # The collectives: counts then data, one all_to_all each.
-        recv_counts = lax.all_to_all(
-            send_counts.reshape(nshards, 1), axis, 0, 0, tiled=False
-        ).reshape(nshards)
-        recv = [
-            lax.all_to_all(b, axis, 0, 0, tiled=False)
-            for b in out_buckets
-        ]
-        # recv[i]: (nshards, send_cap) — bucket from each source shard.
-        out_cols = [r.reshape((nshards * send_cap,) + r.shape[2:])
-                    for r in recv]
-        # Validity: row j of source bucket s is valid iff j < recv_counts[s].
-        row_in_bucket = jnp.arange(send_cap, dtype=np.int32)
-        valid_mask = (row_in_bucket[None, :]
-                      < recv_counts[:, None]).reshape(-1)
+        valid_mask, out_cols = bucket_exchange(
+            axis, nshards, send_cap, dest_row, dest_off, send_counts,
+            s_cols,
+        )
         # Bucket overflow (capacity skew — caller retries with slack)
         # and out-of-range partitioner ids (a user error — caller should
         # raise, matching the host tier's range check) surface as
@@ -224,6 +264,152 @@ def make_shuffle_fn(nshards: int, nkeys: int, capacity: int,
 
     body.masked = body_masked
     return body
+
+
+def make_combine_shuffle_fn(nshards: int, nkeys: int, nvals: int,
+                            cfn, axis: str = "shards", seed: int = 0,
+                            partition_fn: Optional[Callable] = None,
+                            slack: float = 2.0,
+                            nparts: Optional[int] = None,
+                            use_pallas: Optional[bool] = None):
+    """Fused map-side combine + shuffle routing: ONE stable sort serves
+    both stages.
+
+    The separate pipeline (make_segmented_reduce_masked → body_masked)
+    pays two full-payload stable sorts: by (validity, keys) to segment
+    for the combine, then by destination to route. But a row's
+    destination is a pure function of its key prefix, so sorting once by
+    ``(validity, destination[, subid], keys)`` yields intact equal-key
+    segments (equal keys share a destination) whose combined survivors
+    come out already destination-ordered — bucket slots then follow
+    from cumsum/scatter passes, no second sort. In the sort-dominated
+    roofline (BASELINE.md) this removes the single most expensive pass
+    group of the reduce pipeline.
+
+    Guaranteed equivalences with combine-then-shuffle: the same set of
+    combined rows reaches the same (device, subid) destinations, and
+    the overflow / bad-partition signals are zero exactly when the
+    unfused pipeline's are. NOT guaranteed identical: within-bucket row
+    order in waved mode (the fused sort is subid-major where the
+    unfused one interleaves subids in key order — which also changes
+    *which* rows clip on overflow), and the bad count's unit (combined
+    segments here vs post-combine rows there). Consumers are
+    order-insensitive and treat bad as a boolean, so both differences
+    are unobservable through the public ops.
+
+    Returns a ``body`` whose ``.masked(valid, *cols)`` gives
+    ``(recv_valid_mask, overflow, bad, out_cols)`` — same contract as
+    ``make_shuffle_fn(...).masked`` (with the combine already applied).
+    ``cols`` = nkeys key columns then nvals value columns; with
+    ``nparts > nshards`` the out_cols carry the int32 subid column
+    first, like the unfused shuffle.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    from bigslice_tpu.parallel import segment
+
+    if nparts is None:
+        nparts = nshards
+    waved = nparts > nshards
+
+    def body_masked(valid, *cols):
+        size = cols[0].shape[0]
+        cap_send = send_capacity(
+            size, nshards if waved else nparts, slack
+        )
+        keys = cols[:nkeys]
+        vals = cols[nkeys:]
+
+        # Destination from the key prefix — computed BEFORE the sort
+        # (shared routing contract: partition_ids).
+        part, bad, _ = partition_ids(
+            keys, nparts, seed, valid=valid, partition_fn=partition_fn,
+            use_pallas=use_pallas,
+        )
+
+        # Device lane (+ subid when partitions outnumber devices).
+        # Sentinel lane nshards: bad-partitioner rows (valid — counted)
+        # and invalid rows (masked) both park there; `invalid` is the
+        # leading sort key so they stay distinguishable after the sort.
+        routable = part < nparts
+        if waved:
+            dev = jnp.where(routable, part % np.int32(nshards),
+                            np.int32(nshards))
+            subid = jnp.where(routable, part // np.int32(nshards),
+                              np.int32(0))
+        else:
+            dev = jnp.where(routable, part, np.int32(nshards))
+            subid = None
+
+        # THE sort: (validity, device lane[, subid], keys) with values
+        # as payload — combine segmentation and routing order in one.
+        invalid = (~valid).astype(np.int32)
+        sort_keys = ((invalid, dev, subid, *keys) if waved
+                     else (invalid, dev, *keys))
+        nsort = len(sort_keys)
+        s = lax.sort(sort_keys + tuple(vals), num_keys=nsort,
+                     is_stable=True)
+        s_invalid, s_dev = s[0], s[1]
+        s_subid = s[2] if waved else None
+        s_keys = s[2 + waved : nsort]
+        s_vals = s[nsort:]
+
+        # Segment boundaries: any routing/key change starts a segment
+        # (equal keys can't split — they share dev/subid).
+        diff = jnp.zeros(size, dtype=bool).at[0].set(True)
+        for k in (s_invalid, s_dev) + (
+            (s_subid,) if waved else ()
+        ) + tuple(s_keys):
+            diff = diff.at[1:].set(diff[1:] | (k[1:] != k[:-1]))
+        diff = diff | (s_invalid == 1)
+
+        is_last, red = segment.segmented_combine(diff, s_vals, cfn)
+        keep = is_last & (s_invalid == 0)
+        keep_i32 = keep.astype(np.int32)
+
+        # Bucket slots without a sort: rows are dev-ordered, so a
+        # survivor's slot is its global survivor rank minus the rank at
+        # its device run's start (exclusive cumsum of per-lane counts;
+        # the sentinel lane sits last and is sliced off).
+        counts_all = jnp.zeros(nshards + 1, np.int32).at[s_dev].add(
+            keep_i32, mode="drop"
+        )
+        counts = counts_all[:nshards]
+        base = jnp.concatenate(
+            [jnp.zeros(1, np.int32),
+             jnp.cumsum(counts_all).astype(np.int32)[:-1]]
+        )
+        ex_keep = jnp.cumsum(keep_i32).astype(np.int32) - keep_i32
+        offset = ex_keep - jnp.take(base, s_dev)
+
+        n_bad = (
+            jnp.int32(0) if bad is None
+            else (keep & (s_dev == nshards)).sum().astype(np.int32)
+        )
+
+        in_bounds = keep & (offset < cap_send) & (s_dev < nshards)
+        dest_row = jnp.where(in_bounds, s_dev, nshards)
+        dest_off = jnp.where(in_bounds, offset, 0)
+        # Survivor rows hold their segment's full reduction.
+        payload = (
+            ((s_subid,) if waved else ()) + tuple(s_keys) + tuple(red)
+        )
+        send_counts = jnp.minimum(counts, cap_send).astype(np.int32)
+        valid_mask, out_cols = bucket_exchange(
+            axis, nshards, cap_send, dest_row, dest_off, send_counts,
+            payload,
+        )
+        total_overflow = lax.psum(
+            jnp.maximum(counts.max() - cap_send, 0), axis
+        )
+        total_bad = lax.psum(n_bad, axis)
+        return valid_mask, total_overflow, total_bad, out_cols
+
+    class _Body:
+        masked = staticmethod(body_masked)
+
+    return _Body()
 
 
 class MeshShuffle:
@@ -297,13 +483,12 @@ class MeshReduceByKey:
         self.out_capacity = nshards * send_capacity(capacity, nshards, slack)
         ncols = nkeys + nvals
         cfn = segment.canonical_combine(combine_fn, nvals)
-        shuffle_body = make_shuffle_fn(nshards, nkeys, capacity,
-                                       axis, seed, slack=slack)
-        # Mask-chained stages (parallel/segment.py): intermediate stages
-        # pass validity masks instead of front-compacting, skipping two
-        # full-buffer sorts per step versus the count-based chain.
-        combine_masked = segment.make_segmented_reduce_masked(
-            nkeys, nvals, cfn, compact=False
+        # Fused map-side combine + routing: one stable sort by
+        # (validity, destination, keys) serves both stages — see
+        # make_combine_shuffle_fn. The final combine stays separate
+        # (received rows interleave across sources).
+        fused = make_combine_shuffle_fn(
+            nshards, nkeys, nvals, cfn, axis, seed, slack=slack
         )
         combine_final = segment.make_segmented_reduce_masked(
             nkeys, nvals, cfn, compact=True
@@ -314,15 +499,11 @@ class MeshReduceByKey:
 
             n = counts[0]
             size = cols[0].shape[0]
-            key_cols = cols[:nkeys]
-            val_cols = cols[nkeys:]
             mask0 = jnp.arange(size, dtype=np.int32) < n
-            # 1. map-side combine (uncompacted; survivor mask)
-            keep1, k1, v1 = combine_masked(mask0, key_cols, val_cols)
-            # 2. shuffle by key hash (mask in, mask out; hash routing
-            # can't produce out-of-range ids, so `bad` is dropped)
-            recv_mask, overflow, _bad, out_cols = shuffle_body.masked(
-                keep1, *(tuple(k1) + tuple(v1))
+            # 1+2. fused combine + shuffle (hash routing can't produce
+            # out-of-range ids, so `bad` is dropped)
+            recv_mask, overflow, _bad, out_cols = fused.masked(
+                mask0, *cols
             )
             k2 = tuple(out_cols[:nkeys])
             v2 = tuple(out_cols[nkeys:])

@@ -1,0 +1,22 @@
+#!/bin/bash
+# Watch for the TPU tunnel to come alive; when it does, run the full
+# bench suite on the real chip and record results. Exits after success.
+mkdir -p bench_results
+for i in $(seq 1 200); do
+  if timeout 120 python -c "import jax; assert jax.devices()[0].platform == 'tpu'" 2>/dev/null; then
+    echo "$(date -u +%H:%M:%S) probe OK (attempt $i); running bench suite" | tee -a bench_results/watch.log
+    for cfg in "" join wordcount sortshuffle kmeans; do
+      echo "=== bench $cfg $(date -u +%H:%M:%S) ===" >> bench_results/watch.log
+      BIGSLICE_BACKEND_PROBE_RETRIES=1 BIGSLICE_BACKEND_PROBE_TIMEOUT=120 \
+        timeout 900 python bench.py $cfg > bench_results/bench_${cfg:-reduce}.json 2> bench_results/bench_${cfg:-reduce}.err
+      echo "exit=$? output:" >> bench_results/watch.log
+      cat bench_results/bench_${cfg:-reduce}.json >> bench_results/watch.log
+    done
+    echo "DONE $(date -u +%H:%M:%S)" >> bench_results/watch.log
+    exit 0
+  fi
+  echo "$(date -u +%H:%M:%S) probe $i failed" >> bench_results/watch.log
+  sleep 90
+done
+echo "GAVE UP $(date -u +%H:%M:%S)" >> bench_results/watch.log
+exit 1
