@@ -80,6 +80,37 @@ def _looks_like_host_loss(e: BaseException) -> bool:
     return any(m in text for m in _DIST_ERR_MARKERS)
 
 
+# How long a device-probed op stays on the host fallback after an
+# XLA-runtime failure before the device path is retried — the machine
+# probation analog (exec/slicemachine.go:27-28's 30s probation decay).
+PROBATION_SECS = 30.0
+
+
+def _op_base(op: str) -> str:
+    """Strip the compiler's #N repeated-invocation suffix: probation and
+    slack adaptation describe the pipeline SITE (file:line op), which
+    iterative drivers re-invoke under fresh suffixed names each run."""
+    return op.split("#", 1)[0]
+
+
+def _looks_like_infra_error(e: BaseException) -> bool:
+    """Device-runtime-layer failures (OOM, DMA, runtime wedges) — the
+    'machine lost' class: retryable on the host tier, unlike user-code
+    errors (which re-raise identically everywhere). Mirrors the
+    driver-side fatal-vs-lost classification of
+    exec/bigmachine.go:441-454."""
+    if type(e).__name__ == "XlaRuntimeError":
+        return True
+    text = repr(e).lower()
+    # Multi-word/runtime-specific markers only (the _DIST_ERR_MARKERS
+    # rationale): a user ValueError("roadmap...") must not match "dma".
+    return any(m in text for m in (
+        "resource_exhausted", "out of memory", "device halted",
+        "dma error", "dma failed", "dma timed out",
+        "program fingerprint mismatch",
+    ))
+
+
 class DeviceGroupOutput:
     """A group's output resident on the mesh: row-sharded global columns
     plus per-device valid counts. When ``partitioned``, device p holds
@@ -208,6 +239,22 @@ class MeshExecutor:
         # Adapted shuffle slack per op (see _execute_wave): overflow
         # probes run once per op, not once per wave/run.
         self._slack_memo: Dict[str, float] = {}
+        # Probation: ops whose device program hit an XLA-runtime
+        # failure run on the host fallback until the timestamp passes
+        # (single-process only — probation is time-based and local, so
+        # under SPMD it would diverge eligibility across processes and
+        # deadlock the gang; there, infra failures are program-level).
+        self._probation: Dict[str, float] = {}
+        # Keepalive over the coordination service (SPMD multi-process):
+        # a wedged peer is detected BEFORE this process enters a
+        # collective that would hang forever (utils.distributed.
+        # Keepalive); best-effort — inactive without a real
+        # jax.distributed job.
+        self._keepalive = None
+        if self.spmd and self.multiprocess:
+            from bigslice_tpu.utils.distributed import get_keepalive
+
+            self._keepalive = get_keepalive()
         # Ordered dispatch: ONE dispatcher thread launches device groups
         # strictly in the compile-time plan order the session registers
         # (deterministic by construction — the issue-order discipline
@@ -377,6 +424,13 @@ class MeshExecutor:
         # down to the mesh for device-resident chaining).
         if task.chain is None:
             return False
+        until = self._probation.get(_op_base(task.name.op))
+        if until is not None:
+            import time as _time
+
+            if _time.monotonic() < until:
+                return False  # device path on probation for this op
+            self._probation.pop(_op_base(task.name.op), None)
         if not all(ct.is_device for ct in task.schema):
             return False
         if task.num_partition > 1 and not all(
@@ -567,6 +621,10 @@ class MeshExecutor:
                 self.local.submit(t)
             return
         try:
+            if self._keepalive is not None:
+                # Fail fast on a wedged peer instead of entering a
+                # collective that can never complete.
+                self._keepalive.check()
             self._execute_group(key, tasks)
             with self._lock:
                 for t in tasks:
@@ -584,13 +642,32 @@ class MeshExecutor:
             for t in claimed:
                 t.mark_lost(e)
         except Exception as e:  # noqa: BLE001
-            if self.multiprocess and _looks_like_host_loss(e):
+            from bigslice_tpu.utils.distributed import PeerLostError
+
+            if self.multiprocess and (
+                isinstance(e, PeerLostError) or _looks_like_host_loss(e)
+            ):
                 e = HostLostError(
                     f"peer process lost during SPMD group "
                     f"{tasks[0].name.op}: restart the driver on every "
                     f"process (Cache/store short-circuits recompute); "
                     f"cause: {e!r}"
                 )
+            elif not self.multiprocess and _looks_like_infra_error(e):
+                # Machine-loss class: put the op's device path on
+                # probation (exec/slicemachine.go probation analog) and
+                # mark the tasks LOST — the evaluator resubmits them,
+                # and resubmission routes to the host fallback until
+                # probation decays. MAX_CONSECUTIVE_LOST still bounds
+                # pathological loops.
+                import time as _time
+
+                self._probation[_op_base(tasks[0].name.op)] = (
+                    _time.monotonic() + PROBATION_SECS
+                )
+                for t in claimed:
+                    t.mark_lost(e)
+                return
             for t in claimed:
                 t.set_state(TaskState.ERR, e)
 
@@ -658,7 +735,7 @@ class MeshExecutor:
         has_combiner = (task0.num_partition > 1
                         and task0.partitioner.combiner is not None)
         slack = self._slack_memo.get(
-            task0.name.op, 1.0 if has_combiner else 2.0
+            _op_base(task0.name.op), 1.0 if has_combiner else 2.0
         )
         # Wave-partitioned output: more partitions than devices → the
         # shuffle routes per device with a subid payload column.
@@ -696,7 +773,7 @@ class MeshExecutor:
                     f"even at full slack"
                 )
             slack = min(slack * 4, full_slack)
-            self._slack_memo[task0.name.op] = slack
+            self._slack_memo[_op_base(task0.name.op)] = slack
         out_capacity = (
             self.nmesh
             * shuffle_mod.send_capacity(base_capacity, ndest, slack)

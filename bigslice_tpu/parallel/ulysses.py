@@ -1,0 +1,111 @@
+"""Ulysses-style sequence parallelism: all_to_all head/sequence re-shard.
+
+The second of the framework's two long-context lowerings (the first is
+the ring formulation, parallel/ringattention.py — see that module's
+docstring for why the reference has no analog, SURVEY.md §5.7). Where
+ring attention keeps queries resident and rotates K/V blocks hop by
+hop, the Ulysses formulation (DeepSpeed-Ulysses; public recipe)
+re-shards the problem with one collective each way:
+
+    [seq/N, H, d]  --all_to_all-->  [seq, H/N, d]
+        (sharded on sequence)        (sharded on heads)
+
+Each device then computes ordinary full-sequence attention for its
+H/N heads — one big batched matmul pair, the MXU-friendly shape — and
+a second all_to_all restores sequence sharding. Communication is two
+all_to_alls of the Q/K/V/O tensors total (vs nmesh-1 ppermute hops of
+K/V in the ring), so Ulysses wins when heads are plentiful and ICI
+all_to_all bandwidth is good; ring wins when H < N or when the
+sequence is too long for any single device to hold full-seq K/V for
+even one head. Both ride the same 1-D mesh the shuffle uses.
+
+Composition with the data plane matches ringattention: [seq, H, d]
+activations ride as vector columns of a Frame, sharded on the mesh
+like shuffle inputs (shard_columns).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from bigslice_tpu.parallel.meshutil import get_shard_map, mesh_axis
+
+
+def make_ulysses_attention(mesh, nheads: int, d: int,
+                           causal: bool = False, dtype=np.float32):
+    """Build a jitted all-to-all sequence-parallel attention forward.
+
+    Returns ``fn(q, k, v) -> out`` on GLOBAL arrays of shape
+    [seq, nheads, d], row-sharded over the 1-D mesh. Requires
+    ``nheads % nmesh == 0`` (each device owns nheads/nmesh heads in
+    the middle phase) and ``seq % nmesh == 0``.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    axis = mesh_axis(mesh)
+    nmesh = int(mesh.devices.size)
+    if nheads % nmesh != 0:
+        raise ValueError(
+            f"ulysses: nheads ({nheads}) must divide evenly over the "
+            f"mesh ({nmesh} devices); use ring attention for H < N"
+        )
+    shard_map = get_shard_map()
+    scale = 1.0 / np.sqrt(d)
+    neg_inf = np.array(-1e30, dtype)
+
+    def local(q, k, v):
+        # q/k/v: [seq/N, H, d] per device (sequence-sharded).
+        # Phase 1: re-shard to [seq, H/N, d] (head-sharded) — split the
+        # head dim across devices, concatenate the sequence dim.
+        def seq_to_head(x):
+            return lax.all_to_all(x, axis, split_axis=1, concat_axis=0,
+                                  tiled=True)
+
+        qh = seq_to_head(q)  # [seq, H/N, d]
+        kh = seq_to_head(k)
+        vh = seq_to_head(v)
+        seq = qh.shape[0]
+
+        # Phase 2: full-sequence attention for the local heads — the
+        # batched-matmul shape XLA tiles straight onto the MXU.
+        s = jnp.einsum("qhd,khd->hqk", qh, kh) * scale
+        if causal:
+            rows = jnp.arange(seq, dtype=np.int32)
+            s = jnp.where(rows[None, :, None] >= rows[None, None, :],
+                          s, neg_inf)
+        m = s.max(axis=-1, keepdims=True)
+        p = jnp.exp(s - m)
+        o = jnp.einsum("hqk,khd->qhd", p / p.sum(axis=-1, keepdims=True),
+                       vh)
+
+        # Phase 3: restore sequence sharding — split the sequence dim,
+        # concatenate heads back.
+        return lax.all_to_all(o, axis, split_axis=0, concat_axis=1,
+                              tiled=True)
+
+    spec = P(axis)
+    return jax.jit(shard_map(
+        local, mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_rep=False,
+    ))
+
+
+def dense_mha_reference(q, k, v, causal: bool = False):
+    """Host oracle for tests: per-head softmax(QK^T/sqrt(d))V on
+    [seq, H, d] arrays."""
+    q, k, v = (np.asarray(x, np.float64) for x in (q, k, v))
+    seq, h, d = q.shape
+    out = np.empty_like(q)
+    for i in range(h):
+        s = (q[:, i] @ k[:, i].T) / np.sqrt(d)
+        if causal:
+            s = np.where(np.tril(np.ones((seq, seq), bool)), s, -np.inf)
+        s = s - s.max(axis=-1, keepdims=True)
+        p = np.exp(s)
+        out[:, i] = (p / p.sum(axis=-1, keepdims=True)) @ v[:, i]
+    return out

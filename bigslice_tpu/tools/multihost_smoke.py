@@ -219,10 +219,104 @@ def chaos_worker(num_processes: int, process_id: int, port: int) -> int:
         os._exit(0 if ok else 1)
 
 
+def wedge_worker(num_processes: int, process_id: int, port: int) -> int:
+    """Wedged-peer chaos: unlike --chaos (abrupt death — caught by the
+    collective error or the coordination service's own heartbeats), a
+    WEDGED peer stays TCP-alive and service-heartbeat-healthy while its
+    interpreter never reaches the next collective. Only the
+    application-level keepalive (utils.distributed.Keepalive) can see
+    it: the survivor's next run must fail fast with HostLostError
+    (wrapping PeerLostError) at launch time — before entering the
+    collective it would otherwise hang in forever."""
+    from bigslice_tpu.utils.hermetic import force_hermetic_cpu
+
+    force_hermetic_cpu()
+    os.environ["BIGSLICE_KEEPALIVE_INTERVAL"] = "0.5"
+    os.environ["BIGSLICE_KEEPALIVE_TIMEOUT"] = "5"
+    import time
+
+    import numpy as np
+
+    from bigslice_tpu.utils import distributed
+
+    distributed.initialize(
+        coordinator=f"127.0.0.1:{port}",
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    import bigslice_tpu as bs
+    from bigslice_tpu.exec import spmd as spmd_mod
+    from bigslice_tpu.exec.meshexec import HostLostError
+    from bigslice_tpu.exec.task import TaskError
+
+    mesh = distributed.global_mesh()
+    n = int(mesh.devices.size)
+    sess = spmd_mod.spmd_session(mesh)
+    client = distributed._coordination_client()
+
+    def add(a, b):
+        return a + b
+
+    keys = np.arange(n * 16, dtype=np.int32) % 5
+    red = bs.Reduce(bs.Const(n, keys, np.ones(len(keys), np.int32)), add)
+    assert len(dict(sess.run(red).rows())) == 5
+
+    if process_id == 1:
+        # Simulate the hang: stop beating but keep the process (and the
+        # coordination service connection) alive.
+        sess.executor._keepalive.stop()
+        client.key_value_set("bigslice/test/wedged", "1")
+        print("WEDGE: process 1 hung (alive, not beating)", flush=True)
+        time.sleep(300)  # parent kills us
+        os._exit(1)
+
+    client.blocking_key_value_get("bigslice/test/wedged", 60_000)
+    time.sleep(7)  # let the peer's beat go stale past the 5s timeout
+    t0 = time.time()
+    try:
+        sess.run(bs.Reduce(
+            bs.Const(n, keys, np.ones(len(keys), np.int32)), add
+        ))
+        print("WEDGE_FAIL: run succeeded with a wedged peer", flush=True)
+        os._exit(1)
+    except TaskError as e:
+        took = time.time() - t0
+        ok = isinstance(e.cause, HostLostError) and took < 30
+        print(f"WEDGE_{'OK' if ok else 'FAIL'}: "
+              f"{type(e.cause).__name__} after {took:.1f}s", flush=True)
+        sys.stdout.flush()
+        os._exit(0 if ok else 1)
+
+
 def main(argv=None) -> int:
     argv = argv if argv is not None else sys.argv[1:]
     if argv and argv[0] == "--chaos-worker":
         return chaos_worker(int(argv[1]), int(argv[2]), int(argv[3]))
+    if argv and argv[0] == "--wedge-worker":
+        return wedge_worker(int(argv[1]), int(argv[2]), int(argv[3]))
+    if argv and argv[0] == "--wedge":
+        port = _free_port()
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-m",
+                 "bigslice_tpu.tools.multihost_smoke",
+                 "--wedge-worker", "2", str(i), str(port)],
+                env=env,
+            )
+            for i in (0, 1)
+        ]
+        rc = 1
+        try:
+            rc = procs[0].wait(timeout=150)
+        except subprocess.TimeoutExpired:
+            print("WEDGE_FAIL: survivor hung past 150s", flush=True)
+            procs[0].kill()
+        finally:
+            procs[1].kill()  # wedged by design; reap it
+            procs[1].wait(timeout=30)
+        sys.exit(rc)
     if argv and argv[0] == "--chaos":
         import tempfile
 

@@ -532,3 +532,69 @@ def test_wave_partitioned_reshuffle_roundtrip(mesh):
     res = sess.run(r)
     assert sorted(res.rows()) == [(i,) for i in range(24 * 30)]
     assert sess.executor.device_group_count() >= 1
+
+
+def test_infra_error_probation_falls_back_then_recovers(mesh):
+    """XLA-runtime failures are the 'machine lost' class (SURVEY §5.3):
+    the op's tasks go LOST (not ERR), the evaluator resubmits, and the
+    op's device path sits on probation so the retry runs on the host
+    fallback — then re-engages the device once probation decays
+    (exec/slicemachine.go probation analog)."""
+    from bigslice_tpu.exec import meshexec as mx
+
+    class XlaRuntimeError(RuntimeError):
+        pass
+
+    ex = MeshExecutor(mesh)
+    sess = Session(executor=ex)
+    real = ex._execute_group
+    fails = {"n": 0}
+
+    def flaky(key, tasks):
+        if fails["n"] == 0:
+            fails["n"] += 1
+            raise XlaRuntimeError("device halted: injected")
+        return real(key, tasks)
+
+    ex._execute_group = flaky
+
+    keys = (np.arange(64, dtype=np.int32) % 7)
+    vals = np.ones(64, np.int32)
+
+    def add(a, b):
+        return a + b
+
+    def build():
+        # Op names embed the construction site: both runs must build
+        # here so probation (keyed by op) covers the retry.
+        return bs.Reduce(bs.Const(8, keys, vals), add)
+
+    got = dict(sess.run(build()).rows())
+    assert got == {i: 10 if i < 1 else (10 if i < 64 % 7 else 9)
+                   for i in range(7)}
+    assert fails["n"] == 1
+    # The failed op retried on the host fallback and is on probation
+    # (other groups in the graph may still run on device).
+    assert ex._probation, "op should be on probation"
+    probed_ops = set(ex._probation)
+    count_before = ex.device_group_count()
+
+    # Probation decays -> the op's device path re-engages.
+    for op in list(ex._probation):
+        ex._probation[op] = 0.0
+    got2 = dict(sess.run(build()).rows())
+    assert got2 == got
+    assert not (set(ex._probation) & probed_ops), "probation not lifted"
+    assert ex.device_group_count() > count_before
+
+
+def test_user_error_stays_fatal_on_mesh(sess):
+    """User-code failures must NOT be retried as infra losses."""
+    from bigslice_tpu.exec.task import TaskError
+
+    def boom(x):
+        raise ValueError("user bug")
+
+    with pytest.raises(TaskError):
+        sess.run(bs.Map(bs.Const(4, np.arange(16, dtype=np.int32)),
+                        boom, out=[np.int32]))

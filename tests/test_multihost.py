@@ -27,6 +27,25 @@ def test_two_process_distributed_smoke():
     assert "MULTIHOST_SESSION_OK" in out.stdout
 
 
+def test_wedged_peer_detected_by_keepalive():
+    """A peer that hangs WITHOUT dying (TCP alive, coordination-service
+    heartbeats healthy, interpreter stuck) is invisible to both the
+    collective layer and the service's own liveness — only the
+    application keepalive (utils.distributed.Keepalive) sees its beat
+    stall. The survivor must fail fast with HostLostError at group
+    launch, before entering the collective it would hang in."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, "-m", "bigslice_tpu.tools.multihost_smoke",
+         "--wedge"],
+        capture_output=True, text=True, timeout=240, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert out.returncode == 0, (out.stdout[-1500:], out.stderr[-1500:])
+    assert "WEDGE_OK" in out.stdout
+
+
 def test_host_loss_surfaces_fast():
     """A peer dying mid-session fails the survivor's next run FAST with
     a classified HostLostError (the gang-scheduled analog of machine
