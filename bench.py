@@ -506,9 +506,9 @@ def main():
         dev, base = sortshuffle_bench(n_rows)
         emit("shuffle_sort_rows_per_sec", dev, "rows/sec", base)
     elif mode == "kmeans":
-        # Framework-path sizes: the Session pipeline carries points as d
-        # scalar columns through sort-based reduces, so the config
-        # scales d down from the raw-MXU shape on the CPU fallback.
+        # Framework path carries points as ONE [n, d] vector column
+        # (permutation-gather reduce); CPU-fallback sizes stay small
+        # for bounded runtime, TPU runs the raw-MXU shape.
         n_points = size or (1 << 13 if fallback else 1 << 17)
         d, k = (8, 8) if fallback else (64, 64)
         dev, base = kmeans_bench(n_points, d=d, k=k, fallback=fallback)

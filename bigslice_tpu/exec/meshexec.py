@@ -434,11 +434,11 @@ class MeshExecutor:
         if not all(ct.is_device for ct in task.schema):
             return False
         if task.num_partition > 1 and not all(
-            ct.shape == () for ct in task.schema
+            ct.shape == () for ct in task.schema.key
         ):
-            # Vector columns (GroupByKey matrices) can't ride the
-            # shuffle's sort; groups with vector outputs must be roots
-            # or aligned producers.
+            # KEY columns must be scalar (hashable sort operands);
+            # vector VALUE columns ride the shuffle via permutation
+            # gathers and trailing-dim bucket scatters.
             return False
         part = task.partitioner
         if part.combine_key or any(d.combine_key for d in task.deps):
@@ -475,8 +475,13 @@ class MeshExecutor:
         for s in task.chain:
             if isinstance(s, (Const, ReaderFunc, _PrefixedSlice,
                               Reshuffle, Reshard)):
-                if not all(ct.is_device and ct.shape == ()
-                           for ct in s.schema):
+                # Vector (trailing-dim) columns are fine here — keys
+                # only need to be scalar where they drive routing or
+                # combining, which the task-level partitioned check and
+                # the per-stage combiner checks already enforce. (A
+                # bare Const of [n, d] points with the default prefix
+                # must stay device-resident — the kmeans base case.)
+                if not all(ct.is_device for ct in s.schema):
                     return False
                 continue
             if isinstance(s, (Map, Filter, Flatmap)):

@@ -74,8 +74,11 @@ def kmeans(sess, points: np.ndarray, k: int, iters: int = 10,
     """k-means through the slice API: demonstrates the iterative session
     pattern (repeated runs over a reused Result, exec/compile.go:226-261).
 
-    Points ride as ``d`` float32 columns; each iteration Maps every point
-    to its nearest centroid id and Reduces per-centroid sums/counts.
+    Points ride as ONE [n, d] float32 vector column (the data plane's
+    trailing-dim tier): the per-row assignment is a [d]×[k,d] distance
+    reduction, and the per-centroid sum Reduce carries the whole [d]
+    vector through the fused combine+shuffle via permutation gathers —
+    d-way vectorized end-to-end, instead of d scalar columns.
     """
     import bigslice_tpu as bs
 
@@ -83,39 +86,31 @@ def kmeans(sess, points: np.ndarray, k: int, iters: int = 10,
     rng = np.random.RandomState(seed)
     centroids = points[rng.choice(n, size=k, replace=False)].copy()
 
-    cols = [points[:, j].astype(np.float32) for j in range(d)]
-    base = sess.run(bs.Const(num_shards, *cols))  # materialized once
+    base = sess.run(
+        bs.Const(num_shards, points.astype(np.float32))
+    )  # materialized once
 
     for _ in range(iters):
-        # _assign_row/_sum_combine are module-level, and centroids ride as
-        # an unbatched Map arg (data, not a trace constant): every
+        # _assign_vec/_sum_combine are module-level, and centroids ride
+        # as an unbatched Map arg (data, not a trace constant): every
         # iteration reuses the same compiled assignment and reduce
         # kernels instead of recompiling per round.
-        assigned = bs.Map(
-            base, _assign_row,
-            out=[np.int32] + [np.float32] * d + [np.float32],
-            args=(centroids,),
-        )
+        assigned = bs.Map(base, _assign_vec, args=(centroids,))
         summed = bs.Reduce(assigned, _sum_combine)
         rows = sess.run(summed).rows()
-        for row in rows:
-            cid, vec, cnt = row[0], row[1 : 1 + d], row[-1]
+        for cid, vec, cnt in rows:
             if cnt > 0:
-                centroids[cid] = np.asarray(vec, np.float32) / cnt
+                centroids[int(cid)] = np.asarray(vec, np.float32) / cnt
     return centroids
 
 
-def _assign_row(*xs_and_c):
-    """Per-row nearest-centroid assignment; last arg is the unbatched
-    [k, d] centroid matrix."""
+def _assign_vec(x, c):
+    """Per-row nearest-centroid assignment: x is the row's [d] point
+    vector, c the unbatched [k, d] centroid matrix."""
     import jax.numpy as jnp
 
-    xs, c = xs_and_c[:-1], xs_and_c[-1]
-    x = jnp.stack(xs)
     d2 = jnp.sum((c - x[None, :]) ** 2, axis=1)
-    return (jnp.argmin(d2).astype(jnp.int32),) + tuple(xs) + (
-        jnp.float32(1.0),
-    )
+    return (jnp.argmin(d2).astype(jnp.int32), x, jnp.float32(1.0))
 
 
 def _sum_combine(a, b):

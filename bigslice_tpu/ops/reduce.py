@@ -30,9 +30,10 @@ def _vals_traceable(fn: Callable, schema: Schema) -> bool:
     """Can `fn` combine this schema's value columns on device?"""
     if not all(ct.is_device for ct in schema):
         return False
-    if any(ct.shape != () for ct in schema):
-        # The sort-based kernel carries scalar operands only; vector
-        # columns (GroupByKey outputs) combine on the host tier.
+    if any(ct.shape != () for ct in schema.key):
+        # Keys must be scalar (sort operands / hashable); VALUE columns
+        # may be vectors — the kernels route them via permutation
+        # gathers (sort_and_segment) and trailing-dim scatters.
         return False
     try:
         import jax
@@ -40,12 +41,13 @@ def _vals_traceable(fn: Callable, schema: Schema) -> bool:
         nvals = len(schema.values)
         cfn = segment.canonical_combine(fn, nvals)
         specs = tuple(
-            jax.ShapeDtypeStruct((), ct.dtype) for ct in schema.values
+            jax.ShapeDtypeStruct(ct.shape, ct.dtype)
+            for ct in schema.values
         )
         out = jax.eval_shape(lambda *v: cfn(v[:nvals], v[nvals:]),
                              *(specs + specs))
         return all(
-            o.shape == () and np.dtype(o.dtype) == np.dtype(ct.dtype)
+            o.shape == ct.shape and np.dtype(o.dtype) == np.dtype(ct.dtype)
             for o, ct in zip(out, schema.values)
         )
     except Exception:

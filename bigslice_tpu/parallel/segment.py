@@ -41,21 +41,46 @@ def canonical_combine(fn: Callable, nvals: int) -> Callable:
     return cfn
 
 
+def sort_with_payload(sort_keys, num_keys: int, payload):
+    """Stable-sort rows by ``sort_keys`` (scalar int/float columns)
+    carrying ``payload`` columns along — THE shared idiom for every
+    keyed kernel. Scalar payloads ride the multi-operand sort directly;
+    vector payloads (trailing dims — e.g. [n, d] k-means point sums)
+    can't be sort operands, so the sort instead carries a permutation
+    and every payload column moves with one gather. Returns
+    (sorted_key_tuple, sorted_payload_tuple)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    sort_keys = tuple(sort_keys)
+    payload = tuple(payload)
+    if any(getattr(c, "ndim", 1) > 1 for c in payload):
+        size = sort_keys[0].shape[0]
+        iota = jnp.arange(size, dtype=np.int32)
+        s = lax.sort(sort_keys + (iota,), num_keys=num_keys,
+                     is_stable=True)
+        perm = s[-1]
+        return s[:num_keys], tuple(
+            jnp.take(c, perm, axis=0) for c in payload
+        )
+    s = lax.sort(sort_keys + payload, num_keys=num_keys, is_stable=True)
+    return s[:num_keys], s[num_keys:]
+
+
 def sort_and_segment(nkeys: int, valid_mask, key_cols, payload):
     """Shared prelude for keyed kernels: stable-sort rows by (validity,
     keys) with payload columns riding along, and mark segment starts
     (row 0, any key change, validity change; invalid rows isolate into
     their own segments). Returns (s_invalid, s_keys, s_payload, diff)."""
     import jax.numpy as jnp
-    from jax import lax
 
     size = key_cols[0].shape[0]
     invalid = (~valid_mask).astype(np.int32)
-    ops = (invalid,) + tuple(key_cols) + tuple(payload)
-    s = lax.sort(ops, num_keys=1 + nkeys, is_stable=True)
-    s_invalid = s[0]
-    s_keys = s[1 : 1 + nkeys]
-    s_payload = s[1 + nkeys :]
+    sorted_keys, s_payload = sort_with_payload(
+        (invalid,) + tuple(key_cols), 1 + nkeys, payload
+    )
+    s_invalid = sorted_keys[0]
+    s_keys = sorted_keys[1:]
     diff = jnp.zeros(size, dtype=bool).at[0].set(True)
     for k in (s_invalid,) + tuple(s_keys):
         diff = diff.at[1:].set(diff[1:] | (k[1:] != k[:-1]))
@@ -106,8 +131,10 @@ def segmented_combine(diff, s_vals, cfn):
         fx, vx = x
         fy, vy = y
         merged = cfn(vx, vy)
+        # Broadcast the boundary flag over any trailing (vector) dims.
         return (fx | fy, tuple(
-            jnp.where(fy, b, m) for b, m in zip(vy, merged)
+            jnp.where(fy.reshape(fy.shape + (1,) * (b.ndim - 1)), b, m)
+            for b, m in zip(vy, merged)
         ))
 
     _, red = lax.associative_scan(scan_op, (diff, tuple(s_vals)))

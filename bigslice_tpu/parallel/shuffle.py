@@ -203,11 +203,11 @@ def make_shuffle_fn(nshards: int, nkeys: int, capacity: int,
         else:
             ndest = nparts
 
-        # Sort rows by destination; payload rides along.
-        sorted_ops = lax.sort((part,) + tuple(cols), num_keys=1,
-                              is_stable=True)
-        s_part = sorted_ops[0]
-        s_cols = sorted_ops[1:]
+        # Sort rows by destination; payload rides along (vector columns
+        # follow a carried permutation — segment.sort_with_payload).
+        from bigslice_tpu.parallel.segment import sort_with_payload
+
+        (s_part,), s_cols = sort_with_payload((part,), 1, cols)
 
         # Row counts per destination and bucket-local offsets (the
         # fused kernel already produced them on the pallas path; waved
@@ -343,17 +343,17 @@ def make_combine_shuffle_fn(nshards: int, nkeys: int, nvals: int,
             subid = None
 
         # THE sort: (validity, device lane[, subid], keys) with values
-        # as payload — combine segmentation and routing order in one.
+        # as payload — combine segmentation and routing order in one
+        # (vector values follow via segment.sort_with_payload's
+        # carried permutation).
         invalid = (~valid).astype(np.int32)
         sort_keys = ((invalid, dev, subid, *keys) if waved
                      else (invalid, dev, *keys))
         nsort = len(sort_keys)
-        s = lax.sort(sort_keys + tuple(vals), num_keys=nsort,
-                     is_stable=True)
+        s, s_vals = segment.sort_with_payload(sort_keys, nsort, vals)
         s_invalid, s_dev = s[0], s[1]
         s_subid = s[2] if waved else None
         s_keys = s[2 + waved : nsort]
-        s_vals = s[nsort:]
 
         # Segment boundaries: any routing/key change starts a segment
         # (equal keys can't split — they share dev/subid).

@@ -101,11 +101,12 @@ def test_vector_columns_edge_contracts():
                                np.array([3, 4], np.int32)), capacity=4)
     with pytest.raises(typecheck.TypecheckError):
         bs.GroupByKey(g, capacity=2)
-    # Reduce over a vector value column falls back to the host combiner
+    # Reduce over a vector value column lowers to the device kernel
+    # (vector payloads ride permutation gathers through the sort).
     red = bs.Reduce(
         bs.Map(g, lambda k, grp, c: (k % 1, grp)), lambda a, b: a + b
     )
-    assert not red.frame_combiner.device
+    assert red.frame_combiner.device
     rows = slicetest.scan_all(red)
     assert len(rows) == 1
     # Elementwise sum of the two group vectors [3,0,0,0]+[4,0,0,0].
