@@ -42,7 +42,7 @@ import numpy as np
 def emit(metric: str, value: float, unit: str, baseline: float) -> None:
     print(json.dumps({
         "metric": metric,
-        "value": round(value, 1),
+        "value": round(value, 3),
         "unit": unit,
         "vs_baseline": round(value / baseline, 3) if baseline else 0.0,
     }))
@@ -413,6 +413,60 @@ def kmeans_bench(n_points: int, d: int, k: int, rounds: int = 3,
     return (n_points * rounds) / dt, n_points / base_dt
 
 
+# ------------------------------------------------------------- attention
+
+def attention_bench(seq: int, h: int, d: int, iters: int = 5):
+    """Beyond-reference long-context mode: ring vs Ulysses sequence-
+    parallel attention over the mesh, reported as model TFLOP/s
+    (4·seq²·h·d forward FLOPs). Not a BASELINE config — evidence that
+    the long-context tier drives the MXU, and (on TPU) that the ICI
+    collective patterns (ppermute ring, all_to_all re-shard) compile
+    and overlap."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from bigslice_tpu.parallel import ringattention as ra
+    from bigslice_tpu.parallel import ulysses as ul
+
+    mesh = _mesh()
+    rng = np.random.RandomState(0)
+    q, k, v = (rng.randn(seq, h, d).astype(np.float32) * 0.3
+               for _ in range(3))
+    sharding = NamedSharding(mesh, P("shards"))
+    qg, kg, vg = (jax.device_put(x, sharding) for x in (q, k, v))
+    flops = 4.0 * seq * seq * h * d
+
+    def time_fn(fn, *args):
+        out = fn(*args)
+        jax.block_until_ready(out)
+        best = float("inf")
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(*args))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    u_fn = ul.make_ulysses_attention(mesh, nheads=h, d=d, causal=True)
+    t_u = time_fn(u_fn, qg, kg, vg)
+    note(f"attention ulysses: {flops/t_u/1e12:.3f} TFLOP/s "
+         f"(seq={seq}, h={h}, d={d})")
+    r_fn = ra.make_ring_attention(mesh, d=d, causal=True)
+    h0 = (jax.device_put(x[:, 0], sharding) for x in (q, k, v))
+    t_r = time_fn(r_fn, *h0) * h  # one head timed; scale to h heads
+    note(f"attention ring: {flops/t_r/1e12:.3f} TFLOP/s "
+         f"(per-head timing × {h})")
+
+    # CPU baseline: the dense float64 oracle on one head of a REDUCED
+    # sequence (the [seq, seq] temporaries are O(seq²·8B) — at
+    # seq=32k that's ~8.6 GB apiece), scaled by the seq² FLOP ratio.
+    bs_seq = min(seq, 2048)
+    t0 = time.perf_counter()
+    ul.dense_mha_reference(q[:bs_seq, :1], k[:bs_seq, :1],
+                           v[:bs_seq, :1], causal=True)
+    base_t = (time.perf_counter() - t0) * h * (seq / bs_seq) ** 2
+    return flops / min(t_u, t_r) / 1e12, flops / base_t / 1e12
+
+
 # ------------------------------------------------------------------ main
 
 def mosaic_gate() -> None:
@@ -455,7 +509,7 @@ def main():
     args = sys.argv[1:]
     mode = "reduce"
     known = ("reduce", "reduce-kernel", "join", "join-kernel",
-             "wordcount", "sortshuffle", "kmeans")
+             "wordcount", "sortshuffle", "kmeans", "attention")
     if args and args[0] in known:
         mode = args.pop(0)
     size = int(args[0]) if args else None
@@ -505,6 +559,18 @@ def main():
         n_rows = size or (1 << 20 if fallback else 1 << 24)
         dev, base = sortshuffle_bench(n_rows)
         emit("shuffle_sort_rows_per_sec", dev, "rows/sec", base)
+    elif mode == "attention":
+        import jax
+
+        seq = size or (1 << 12 if fallback else 1 << 15)
+        # Heads must divide over the mesh (Ulysses re-shard) — derive
+        # from however many devices this slice actually has.
+        nmesh = max(1, len(jax.devices()))
+        h = nmesh * (1 if fallback else 2)
+        d = 32 if fallback else 128
+        seq = max(seq, nmesh * 8)
+        dev, base = attention_bench(seq, h, d)
+        emit("seq_parallel_attention_tflops", dev, "TFLOP/s", base)
     elif mode == "kmeans":
         # Framework path carries points as ONE [n, d] vector column
         # (permutation-gather reduce); CPU-fallback sizes stay small

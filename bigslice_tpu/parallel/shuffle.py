@@ -345,20 +345,27 @@ def make_combine_shuffle_fn(nshards: int, nkeys: int, nvals: int,
         # THE sort: (validity, device lane[, subid], keys) with values
         # as payload — combine segmentation and routing order in one
         # (vector values follow via segment.sort_with_payload's
-        # carried permutation).
+        # carried permutation). Validity and the device lane pack into
+        # ONE int32 operand — their lexicographic order is preserved by
+        # invalid * (nshards+2) + dev (dev ≤ nshards) — trimming an
+        # operand from every pass of the sort network.
         invalid = (~valid).astype(np.int32)
-        sort_keys = ((invalid, dev, subid, *keys) if waved
-                     else (invalid, dev, *keys))
+        route = invalid * np.int32(nshards + 2) + dev
+        sort_keys = ((route, subid, *keys) if waved
+                     else (route, *keys))
         nsort = len(sort_keys)
         s, s_vals = segment.sort_with_payload(sort_keys, nsort, vals)
-        s_invalid, s_dev = s[0], s[1]
-        s_subid = s[2] if waved else None
-        s_keys = s[2 + waved : nsort]
+        s_route = s[0]
+        s_invalid = (s_route >= nshards + 2).astype(np.int32)
+        s_dev = s_route - s_invalid * np.int32(nshards + 2)
+        s_subid = s[1] if waved else None
+        s_keys = s[1 + waved : nsort]
 
         # Segment boundaries: any routing/key change starts a segment
-        # (equal keys can't split — they share dev/subid).
+        # (equal keys can't split — they share dev/subid; the packed
+        # route covers validity + device in one comparison).
         diff = jnp.zeros(size, dtype=bool).at[0].set(True)
-        for k in (s_invalid, s_dev) + (
+        for k in (s_route,) + (
             (s_subid,) if waved else ()
         ) + tuple(s_keys):
             diff = diff.at[1:].set(diff[1:] | (k[1:] != k[:-1]))
