@@ -562,13 +562,18 @@ def main():
     elif mode == "attention":
         import jax
 
-        seq = size or (1 << 12 if fallback else 1 << 15)
+        # seq is bounded by the Ulysses [h_local, seq, seq] score
+        # temporaries: seq=8k → ~0.5 GB over two temporaries — safe in
+        # v5e's 16 GB HBM; 32k would need ~17 GB and OOM.
+        seq = size or (1 << 12 if fallback else 1 << 13)
         # Heads must divide over the mesh (Ulysses re-shard) — derive
         # from however many devices this slice actually has.
         nmesh = max(1, len(jax.devices()))
         h = nmesh * (1 if fallback else 2)
         d = 32 if fallback else 128
+        # Sequence shards over the mesh: round up to a multiple.
         seq = max(seq, nmesh * 8)
+        seq = ((seq + nmesh - 1) // nmesh) * nmesh
         dev, base = attention_bench(seq, h, d)
         emit("seq_parallel_attention_tflops", dev, "TFLOP/s", base)
     elif mode == "kmeans":

@@ -101,23 +101,25 @@ def _build_hash_partition(nparts: int, block_rows: int, seed32: int,
         ids_ref[:] = ids
 
         if counts_ref is not None:
-            # Per-block histogram: compare against a lane iota and
-            # reduce over the block's rows/lanes. The drop lane id ==
-            # nparts never matches a counted lane (counts are sliced to
-            # [:nparts]); invalid rows therefore never count.
-            pid = jax.lax.broadcasted_iota(
-                jnp.int32, (1, hist_lanes), dimension=1
-            )
-            onehot = (ids.reshape(-1, 1) == pid.reshape(1, -1)).astype(
-                jnp.int32
-            )
-            local = jnp.sum(onehot, axis=0, keepdims=True)
-
+            # Per-block histogram. All-pairs compare per 128-lane chunk
+            # of the histogram, in 3D (block_rows, LANES, LANES) — no
+            # reshapes/relayouts, which Mosaic rejects (a (8,128)→
+            # (1024,1) shape cast fails infer-vector-layout on real
+            # hardware). The drop lane id == nparts never matches a
+            # counted lane (counts are sliced to [:nparts]); invalid
+            # rows therefore never count.
             @pl.when(step == 0)
             def _init():
                 counts_ref[:] = jnp.zeros_like(counts_ref)
 
-            counts_ref[:] += local
+            ids3 = ids[:, :, None]  # (block_rows, LANES, 1)
+            for c in range(hist_lanes // LANES):
+                pid = jax.lax.broadcasted_iota(
+                    jnp.int32, (1, 1, LANES), dimension=2
+                ) + jnp.int32(c * LANES)
+                onehot = (ids3 == pid).astype(jnp.int32)
+                local = jnp.sum(onehot, axis=(0, 1), keepdims=True)
+                counts_ref[0:1, c * LANES : (c + 1) * LANES] += local[0]
 
     def run(mask2d, *keys2d):
         rows = mask2d.shape[0]

@@ -1,22 +1,22 @@
 #!/bin/bash
 # Watch for the TPU tunnel to come alive; when it does, run the full
-# bench suite on the real chip and record results. Exits after success.
+# bench matrix (one process, incremental results) and record. Exits
+# after a successful full sweep.
 mkdir -p bench_results
-for i in $(seq 1 200); do
-  if timeout 120 python -c "import jax; assert jax.devices()[0].platform == 'tpu'" 2>/dev/null; then
-    echo "$(date -u +%H:%M:%S) probe OK (attempt $i); running bench suite" | tee -a bench_results/watch.log
-    for cfg in "" join wordcount sortshuffle kmeans; do
-      echo "=== bench $cfg $(date -u +%H:%M:%S) ===" >> bench_results/watch.log
-      BIGSLICE_BACKEND_PROBE_RETRIES=1 BIGSLICE_BACKEND_PROBE_TIMEOUT=120 \
-        timeout 900 python bench.py $cfg > bench_results/bench_${cfg:-reduce}.json 2> bench_results/bench_${cfg:-reduce}.err
-      echo "exit=$? output:" >> bench_results/watch.log
-      cat bench_results/bench_${cfg:-reduce}.json >> bench_results/watch.log
-    done
-    echo "DONE $(date -u +%H:%M:%S)" >> bench_results/watch.log
-    exit 0
+for i in $(seq 1 300); do
+  if timeout 150 python -c "import jax; assert jax.devices()[0].platform == 'tpu'" 2>/dev/null; then
+    echo "$(date -u +%H:%M:%S) probe OK (attempt $i); running bench matrix" | tee -a bench_results/watch.log
+    timeout 3000 python tools_bench_all.py fast >> bench_results/watch.log 2>&1
+    rc=$?
+    echo "$(date -u +%H:%M:%S) bench matrix exit=$rc" >> bench_results/watch.log
+    if [ $rc -eq 0 ]; then
+      echo "DONE $(date -u +%H:%M:%S)" >> bench_results/watch.log
+      exit 0
+    fi
+  else
+    echo "$(date -u +%H:%M:%S) probe $i failed" >> bench_results/watch.log
   fi
-  echo "$(date -u +%H:%M:%S) probe $i failed" >> bench_results/watch.log
-  sleep 90
+  sleep 60
 done
 echo "GAVE UP $(date -u +%H:%M:%S)" >> bench_results/watch.log
 exit 1
