@@ -175,6 +175,16 @@ def reduce_e2e_bench(keys, vals, iters: int = 3):
 
 # ------------------------------------------------------------------ join
 
+def join_inputs(n_rows: int):
+    """The join benches' synthetic two-sided keyed input — ONE
+    derivation shared by the bench bodies, main(), and tools_bench_all
+    so the measured workload and its CPU baseline can't drift apart."""
+    nk = max(16, n_rows // 16)
+    r1, r2 = np.random.RandomState(1), np.random.RandomState(2)
+    return (r1.randint(0, nk, n_rows).astype(np.int32),
+            r2.randint(0, nk, n_rows).astype(np.int32))
+
+
 def cpu_join_baseline(ak, bk) -> float:
     t0 = time.perf_counter()
     ka, ca = np.unique(ak, return_counts=True)
@@ -231,10 +241,7 @@ def join_e2e_bench(n_rows: int, iters: int = 3):
     mesh = _mesh()
     sess = _mesh_session(mesh)
     n = mesh.devices.size
-    nkeys = max(16, n_rows // 16)
-    r1, r2 = np.random.RandomState(1), np.random.RandomState(2)
-    ak = r1.randint(0, nkeys, n_rows).astype(np.int32)
-    bk = r2.randint(0, nkeys, n_rows).astype(np.int32)
+    ak, bk = join_inputs(n_rows)
     ones = np.ones(n_rows, np.int32)
 
     def add(a, b):
@@ -467,6 +474,24 @@ def attention_bench(seq: int, h: int, d: int, iters: int = 5):
     return flops / min(t_u, t_r) / 1e12, flops / base_t / 1e12
 
 
+def attention_config(size, fallback: bool, nmesh: int):
+    """(seq, heads, head_dim) for the attention mode — one derivation
+    shared by main() and tools_bench_all so the sizing rules (HBM-safe
+    seq cap, heads divisible over the mesh, seq a mesh multiple) can't
+    drift."""
+    # seq is bounded by the Ulysses [h_local, seq, seq] score
+    # temporaries: seq=8k → ~0.5 GB over two temporaries — safe in
+    # v5e's 16 GB HBM; 32k would need ~17 GB and OOM.
+    seq = size or (1 << 12 if fallback else 1 << 13)
+    # Heads must divide over the mesh (Ulysses re-shard).
+    h = nmesh * (1 if fallback else 2)
+    d = 32 if fallback else 128
+    # Sequence shards over the mesh: round up to a multiple.
+    seq = max(seq, nmesh * 8)
+    seq = ((seq + nmesh - 1) // nmesh) * nmesh
+    return seq, h, d
+
+
 # ------------------------------------------------------------------ main
 
 def mosaic_gate() -> None:
@@ -534,22 +559,12 @@ def main():
     elif mode == "join":
         n_rows = size or (1 << 18 if fallback else 1 << 23)
         dev = join_e2e_bench(n_rows)
-        r1, r2 = np.random.RandomState(1), np.random.RandomState(2)
-        nk = max(16, n_rows // 16)
-        base = cpu_join_baseline(
-            r1.randint(0, nk, n_rows).astype(np.int32),
-            r2.randint(0, nk, n_rows).astype(np.int32),
-        )
+        base = cpu_join_baseline(*join_inputs(n_rows))
         emit("join_aggregate_e2e_rows_per_sec", dev, "rows/sec", base)
     elif mode == "join-kernel":
         n_rows = size or (1 << 19 if fallback else 1 << 23)
         dev = join_kernel_bench(n_rows)
-        r1, r2 = np.random.RandomState(1), np.random.RandomState(2)
-        nk = max(16, n_rows // 16)
-        base = cpu_join_baseline(
-            r1.randint(0, nk, n_rows).astype(np.int32),
-            r2.randint(0, nk, n_rows).astype(np.int32),
-        )
+        base = cpu_join_baseline(*join_inputs(n_rows))
         emit("join_aggregate_rows_per_sec", dev, "rows/sec", base)
     elif mode == "wordcount":
         n_rows = size or (1 << 20 if fallback else 1 << 24)
@@ -562,18 +577,9 @@ def main():
     elif mode == "attention":
         import jax
 
-        # seq is bounded by the Ulysses [h_local, seq, seq] score
-        # temporaries: seq=8k → ~0.5 GB over two temporaries — safe in
-        # v5e's 16 GB HBM; 32k would need ~17 GB and OOM.
-        seq = size or (1 << 12 if fallback else 1 << 13)
-        # Heads must divide over the mesh (Ulysses re-shard) — derive
-        # from however many devices this slice actually has.
-        nmesh = max(1, len(jax.devices()))
-        h = nmesh * (1 if fallback else 2)
-        d = 32 if fallback else 128
-        # Sequence shards over the mesh: round up to a multiple.
-        seq = max(seq, nmesh * 8)
-        seq = ((seq + nmesh - 1) // nmesh) * nmesh
+        seq, h, d = attention_config(
+            size, fallback, max(1, len(jax.devices()))
+        )
         dev, base = attention_bench(seq, h, d)
         emit("seq_parallel_attention_tflops", dev, "TFLOP/s", base)
     elif mode == "kmeans":

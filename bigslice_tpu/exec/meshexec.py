@@ -118,11 +118,18 @@ class DeviceGroupOutput:
     holds shard s's output."""
 
     def __init__(self, cols, counts, capacity: int, schema,
-                 partitioned: bool, subid: bool = False):
+                 partitioned: bool, subid: bool = False,
+                 nmesh: Optional[int] = None):
         self.cols = cols
         self.counts = counts
         self.capacity = capacity
         self.schema = schema
+        # Mesh size at production time: partition/shard → device
+        # indexing must use THIS, not the executor's current mesh
+        # (resize may change the latter while this output lives on).
+        self.nmesh = nmesh if nmesh is not None else (
+            len(counts) if hasattr(counts, "__len__") else 0
+        )
         self.partitioned = partitioned
         # Wave-partitioned shuffle outputs (num_partition > mesh) carry
         # an int32 subid as cols[0]: partition p lives on device
@@ -164,6 +171,16 @@ class DeviceGroupOutput:
                     self.cols, np.asarray(self.counts), self.capacity
                 )
             return self._chunks
+
+    def drop_device(self) -> None:
+        """Materialize to host and release the device-resident arrays.
+        After a mesh resize the old arrays are sharded over a mesh that
+        no longer matches compiled programs (and may reference dead
+        devices) — consumers must go through host_chunks + re-upload,
+        never zero-copy chaining."""
+        self.host_chunks()
+        self.cols = None
+        self.counts = None
 
 
 class _BridgedStore(store_mod.MemoryStore):
@@ -402,6 +419,58 @@ class MeshExecutor:
         groups may legitimately fall back under scheduling pressure)."""
         with self._lock:
             return len(self._outputs)
+
+    def resize(self, mesh) -> List[Task]:
+        """Elasticity (SURVEY §5.3's TPU mapping (c); the analog of the
+        reference's demand-driven capacity, exec/slicemachine.go:586-601,
+        and machine-loss handling, exec/slicemachine.go:148-227): swap
+        the device mesh between runs — shrink after device/host loss,
+        grow when capacity returns. Shard counts and mesh size already
+        decouple (padding / wave streaming), so a task graph compiled
+        for any shard count runs unchanged on the new mesh.
+
+        Committed group outputs resident on the old mesh are salvaged to
+        host where their devices still answer; outputs that are gone
+        with the lost hardware have their tasks marked LOST instead —
+        the evaluator (or a Result's re-eval-before-read) recomputes
+        them on the new mesh from materialized inputs, the store-
+        checkpoint mechanism of SURVEY §5.4(1). Compiled SPMD programs,
+        shuffle-slack adaptations, and probation state are per-mesh and
+        reset. Returns the tasks marked LOST.
+
+        Call between runs only (no groups in flight) — the elastic
+        Session retry loop guarantees this by draining evaluation
+        before resizing."""
+        lost: List[Tuple[Task, BaseException]] = []
+        with self._lock:
+            for key in list(self._outputs):
+                out = self._outputs[key]
+                try:
+                    waves = getattr(out, "waves", None)
+                    for w in (waves if waves is not None else [out]):
+                        # Salvage AND drop device residency: the old
+                        # arrays are sharded over the outgoing mesh and
+                        # must never zero-copy into new-mesh programs.
+                        w.drop_device()
+                except Exception as e:  # device data died with the mesh
+                    del self._outputs[key]
+                    for name, (k2, t) in list(self._task_index.items()):
+                        if k2 == key:
+                            del self._task_index[name]
+                            if t.state == TaskState.OK:
+                                lost.append((t, RuntimeError(
+                                    f"output of {name} lost in mesh "
+                                    f"resize: {e!r}"
+                                )))
+            self._programs.clear()
+            self._slack_memo.clear()
+            self._probation.clear()
+            self.mesh = mesh
+            self.nmesh = int(mesh.devices.size)
+            self.multiprocess = shuffle_mod.is_multiprocess_mesh(mesh)
+        for t, err in lost:  # outside the lock: transitions notify subs
+            t.mark_lost(err)
+        return [t for t, _ in lost]
 
     def reader(self, task: Task, partition: int) -> sliceio.Reader:
         return self.store.read(task.name, partition)
@@ -787,7 +856,7 @@ class MeshExecutor:
         return DeviceGroupOutput(
             list(out_cols), out_counts, out_capacity, task0.schema,
             partitioned=task0.num_partition > 1,
-            subid=has_shuffle and out_subid,
+            subid=has_shuffle and out_subid, nmesh=self.nmesh,
         )
 
     def _merge_outputs(self, outs: List[DeviceGroupOutput],
@@ -852,7 +921,7 @@ class MeshExecutor:
         )
         return DeviceGroupOutput(
             list(cols), counts, sum(caps), task0.schema,
-            partitioned=True, subid=outs[0].subid,
+            partitioned=True, subid=outs[0].subid, nmesh=self.nmesh,
         )
 
     def _group_inputs(self, tasks: List[Task], wave: int = 0):
@@ -877,8 +946,16 @@ class MeshExecutor:
         dep0 = task0.deps[dep_idx]
         pkey = dep0.tasks[0].group_key
         out = self._outputs.get(pkey)
+        if out is not None and getattr(out, "waves", None) is None \
+                and (out.cols is None or out.nmesh != self.nmesh):
+            # Post-resize output (device arrays dropped, or sharded
+            # over a previous mesh): no zero-copy chaining — read the
+            # salvaged host chunks through the store bridge and
+            # re-upload onto the current mesh.
+            out = None
         if isinstance(out, WavedGroupOutput):
-            if len(dep0.tasks) == 1:
+            if len(dep0.tasks) == 1 and out.nmesh == self.nmesh \
+                    and out.waves[wave].cols is not None:
                 # Aligned dep on a waved producer: consumer wave w's
                 # shards align with producer wave w (same mesh size).
                 wout = out.waves[wave]
@@ -1302,9 +1379,11 @@ class MeshExecutor:
                 return []
             if out.subid:
                 # Wave-partitioned: device p % nmesh holds partition p
-                # where the leading subid column == p // nmesh.
-                dev = partition % self.nmesh
-                sub = partition // self.nmesh
+                # where the leading subid column == p // nmesh — the
+                # PRODUCING mesh's size (resize may have changed the
+                # executor's since).
+                dev = partition % out.nmesh
+                sub = partition // out.nmesh
                 dev_cols = [c[dev] for c in chunks]
                 sel = np.asarray(dev_cols[0]) == sub
                 cols = [np.asarray(c)[sel] for c in dev_cols[1:]]

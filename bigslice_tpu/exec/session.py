@@ -23,6 +23,29 @@ from bigslice_tpu.exec.task import Task, TaskState
 from bigslice_tpu.utils import metrics as metrics_mod
 
 
+def _is_gang_loss(e: BaseException) -> bool:
+    """Is this failure the gang/host-loss class the elastic retry can
+    recover from by re-forming the mesh? (Ordinary application errors
+    re-raise — re-running them on a different mesh is useless.)"""
+    from bigslice_tpu.exec.meshexec import HostLostError
+    from bigslice_tpu.exec.task import TaskError
+    from bigslice_tpu.utils.distributed import PeerLostError
+
+    seen = set()
+    stack = [e]
+    while stack:
+        err = stack.pop()
+        if id(err) in seen or err is None:
+            continue
+        seen.add(id(err))
+        if isinstance(err, (HostLostError, PeerLostError)):
+            return True
+        if isinstance(err, TaskError):
+            stack.append(err.cause)
+        stack.append(err.__cause__)
+    return False
+
+
 class _InvocationGate:
     """Reader-writer isolation for exclusive invocations: normal runs
     share the session (readers); an exclusive Func's run takes the whole
@@ -137,6 +160,19 @@ class Session:
       exec/session.go:166-176) — fewer, larger combines at the cost of
       coarser retry granularity
     - ``monitor``: raw ``(task, state)`` transition callback
+    - ``elastic``: max mesh-recovery retries per run. When a run dies
+      with a gang/host-loss class error (``HostLostError`` in the
+      failure chain), the session asks ``mesh_provider`` for the
+      current healthy mesh, resizes the executor onto it (salvaging
+      reachable outputs, re-marking unreachable ones LOST), and
+      re-evaluates — completed tasks keep their results; the SPMD
+      analog of the reference's machine-loss→task-resubmit loop
+      (exec/slicemachine.go:148-227) at mesh granularity. The same
+      seam grows: a provider returning a bigger mesh is demand-driven
+      capacity (exec/slicemachine.go:586-601).
+    - ``mesh_provider``: zero-arg callable returning the mesh to use
+      for the next elastic attempt (platform-specific discovery of
+      surviving/available devices).
     """
 
     def __init__(self, executor=None, parallelism: Optional[int] = None,
@@ -144,7 +180,8 @@ class Session:
                  status: bool = False, eventer=None,
                  machine_combiners: bool = False,
                  debug_port: Optional[int] = None,
-                 xprof_dir: Optional[str] = None):
+                 xprof_dir: Optional[str] = None,
+                 elastic: int = 0, mesh_provider=None):
         from bigslice_tpu.utils import status as status_mod
         from bigslice_tpu.utils import trace as trace_mod
 
@@ -153,6 +190,8 @@ class Session:
 
             executor = LocalExecutor(procs=parallelism)
         self.executor = executor
+        self.elastic = elastic
+        self.mesh_provider = mesh_provider
         self.eventer = eventer
         self.trace_path = trace_path
         self.tracer = trace_mod.Tracer() if trace_path else None
@@ -232,63 +271,133 @@ class Session:
         ).compile(slice_)
         if self.debug is not None:
             self.debug.register_roots(tasks)
-        run_token = None
-        plan_groups = getattr(self.executor, "plan_groups", None)
-        if plan_groups is not None:
-            from bigslice_tpu.exec.task import TaskState, iter_tasks
-
-            # Post-order DFS is deterministic given the same program —
-            # the ordered dispatcher's cross-process launch sequence.
-            # Groups whose members are all already OK (Result reuse)
-            # are omitted: nothing of theirs will launch.
-            groups: Dict[Any, list] = {}
-            order = []
-            for t in iter_tasks(tasks):
-                if t.group_key is None:
-                    continue
-                if t.group_key not in groups:
-                    groups[t.group_key] = []
-                    order.append(t.group_key)
-                groups[t.group_key].append(t)
-            run_token = object()  # collision-free per-run identity
-            plan_groups(
-                ((k, groups[k]) for k in order
-                 if not all(m.state == TaskState.OK
-                            for m in groups[k])),
-                token=run_token,
-            )
         # Exclusive invocations evaluate in isolation from concurrent
         # runs of this session; their own shards stay parallel.
         self._gate.acquire(exclusive)
-        xprof = None
         try:
-            if (self.xprof_dir
-                    and self._xprof_lock.acquire(blocking=False)):
-                # One active XPlane trace at a time (concurrent runs
-                # skip). Profiler failures (unwritable dir, another
-                # live profiler) must not leak the gate or the lock.
+            attempts = 0
+            while True:
+                run_token = self._plan_run(tasks)
+                xprof = None
+                err = None
                 try:
-                    import jax
+                    if (self.xprof_dir
+                            and self._xprof_lock.acquire(blocking=False)):
+                        # One active XPlane trace at a time (concurrent
+                        # runs skip). Profiler failures (unwritable dir,
+                        # another live profiler) must not leak the gate
+                        # or the lock.
+                        try:
+                            import jax
 
-                    xprof = jax.profiler.trace(self.xprof_dir)
-                    xprof.__enter__()
-                except Exception:
-                    xprof = None
-                    self._xprof_lock.release()
-            evaluate(self.executor, tasks, monitor=self.monitor)
-        finally:
-            if xprof is not None:
-                try:
-                    xprof.__exit__(None, None, None)
-                except Exception:
-                    pass
+                            xprof = jax.profiler.trace(self.xprof_dir)
+                            xprof.__enter__()
+                        except Exception:
+                            xprof = None
+                            self._xprof_lock.release()
+                    evaluate(self.executor, tasks, monitor=self.monitor)
+                except Exception as e:  # noqa: BLE001
+                    err = e
                 finally:
-                    self._xprof_lock.release()
+                    if xprof is not None:
+                        try:
+                            xprof.__exit__(None, None, None)
+                        except Exception:
+                            pass
+                        finally:
+                            self._xprof_lock.release()
+                    # finish_run BEFORE the retry decision: it flushes
+                    # an aborted run's parked tasks to the fallback so
+                    # they settle (the recover step waits for them).
+                    finish = getattr(self.executor, "finish_run", None)
+                    if finish is not None:
+                        finish(token=run_token)
+                if err is None:
+                    break
+                if attempts >= self.elastic or not _is_gang_loss(err):
+                    raise err
+                # Recovery mutates the shared executor (mesh swap), so
+                # quiesce the session first: trade our reader slot for
+                # the writer (waits out concurrent runs; new runs block
+                # until recovery is done), then trade back.
+                if not exclusive:
+                    self._gate.release(False)
+                    self._gate.acquire(True)
+                try:
+                    recovered = self._elastic_recover(tasks, err)
+                finally:
+                    if not exclusive:
+                        self._gate.release(True)
+                        self._gate.acquire(False)
+                if not recovered:
+                    raise err
+                attempts += 1
+        finally:
             self._gate.release(exclusive)
-            finish = getattr(self.executor, "finish_run", None)
-            if finish is not None:
-                finish(token=run_token)
         return Result(self, slice_, tasks)
+
+    def _plan_run(self, tasks):
+        """Register this evaluation attempt's deterministic group launch
+        order with an ordered-dispatch executor; returns the run token
+        (None when the executor doesn't plan)."""
+        plan_groups = getattr(self.executor, "plan_groups", None)
+        if plan_groups is None:
+            return None
+        from bigslice_tpu.exec.task import TaskState, iter_tasks
+
+        # Post-order DFS is deterministic given the same program —
+        # the ordered dispatcher's cross-process launch sequence.
+        # Groups whose members are all already OK (Result reuse)
+        # are omitted: nothing of theirs will launch.
+        groups: Dict[Any, list] = {}
+        order = []
+        for t in iter_tasks(tasks):
+            if t.group_key is None:
+                continue
+            if t.group_key not in groups:
+                groups[t.group_key] = []
+                order.append(t.group_key)
+            groups[t.group_key].append(t)
+        run_token = object()  # collision-free per-run identity
+        plan_groups(
+            ((k, groups[k]) for k in order
+             if not all(m.state == TaskState.OK
+                        for m in groups[k])),
+            token=run_token,
+        )
+        return run_token
+
+    def _elastic_recover(self, tasks, cause) -> bool:
+        """Between elastic attempts: move the executor onto the current
+        healthy mesh and return fatal tasks to INIT so the next
+        evaluation re-runs them (completed tasks keep their — salvaged —
+        results). Returns False — retry is unsafe, re-raise — when any
+        task is still in flight (a thread wedged inside a collective
+        outlived the evaluator's drain: a fresh evaluation would wait on
+        it forever)."""
+        import time
+
+        from bigslice_tpu.exec.task import TaskState, iter_tasks
+
+        all_tasks = iter_tasks(tasks)
+        # Flushed/parked tasks settle through the fallback executor
+        # shortly after finish_run; a thread truly wedged inside a
+        # collective never will. Bounded wait separates the two.
+        deadline = time.monotonic() + 30.0
+        while any(t.state in (TaskState.WAITING, TaskState.RUNNING)
+                  for t in all_tasks):
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.05)
+        mesh = self.mesh_provider() if self.mesh_provider else None
+        resize = getattr(self.executor, "resize", None)
+        if resize is not None and mesh is not None:
+            resize(mesh)
+        for t in all_tasks:
+            if t.state == TaskState.ERR:
+                t.reset_for_retry()
+        self._event("bigslice:elasticRetry", cause=repr(cause))
+        return True
 
     # Go-flavored alias (Session.Must): raise on error is Python's default.
     must = run

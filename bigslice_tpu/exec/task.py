@@ -175,6 +175,15 @@ class Task:
             self.consecutive_lost = 0
         self.set_state(TaskState.OK)
 
+    def reset_for_retry(self) -> None:
+        """Return a fatal task to INIT for a fresh evaluation attempt
+        (the session's elastic mesh recovery): the consecutive-loss
+        debt is cleared so the cap measures losses on the new mesh
+        only."""
+        with self._lock:
+            self.consecutive_lost = 0
+        self.set_state(TaskState.INIT)
+
     def mark_lost(self, error: Optional[BaseException] = None) -> None:
         """Record a loss (machine failure / missing output); the evaluator
         resubmits lost tasks up to a consecutive-loss cap

@@ -1,0 +1,176 @@
+"""Elasticity: mesh shrink/regrow between runs with output salvage and
+task re-run (SURVEY §5.3's TPU mapping (c) — the analog of the
+reference's machine-loss handling, exec/slicemachine.go:148-227, and
+demand-driven capacity, exec/slicemachine.go:586-601, at mesh
+granularity)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+import bigslice_tpu as bs
+from bigslice_tpu.exec.meshexec import HostLostError, MeshExecutor
+from bigslice_tpu.exec.session import Session
+from bigslice_tpu.exec.task import TaskState
+
+
+def make_mesh(n):
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(jax.devices()[:n]), ("shards",))
+
+
+def reduce_oracle(keys, vals):
+    out = {}
+    for k, v in zip(keys.tolist(), vals.tolist()):
+        out[k] = out.get(k, 0) + v
+    return out
+
+
+def keyed_input(n=800, nkeys=40, seed=0):
+    rng = np.random.RandomState(seed)
+    return (rng.randint(0, nkeys, n).astype(np.int32),
+            rng.randint(0, 10, n).astype(np.int32))
+
+
+def test_resize_shrink_salvages_results_and_reengages_device():
+    keys, vals = keyed_input()
+    sess = Session(executor=MeshExecutor(make_mesh(8)))
+    res1 = sess.run(bs.Reduce(bs.Const(8, keys, vals), lambda a, b: a + b))
+    assert sess.executor.device_group_count() >= 1
+
+    lost = sess.executor.resize(make_mesh(4))
+    assert lost == []  # all outputs reachable: salvaged, nothing LOST
+    assert sess.executor.nmesh == 4
+
+    # Results computed on the old mesh remain readable after the swap.
+    assert dict(res1.rows()) == reduce_oracle(keys, vals)
+
+    # New runs engage the device path on the shrunk mesh — including
+    # 8-shard graphs (wave streaming decouples shards from mesh size).
+    before = sess.executor.device_group_count()
+    keys2, vals2 = keyed_input(seed=1)
+    res2 = sess.run(bs.Reduce(bs.Const(8, keys2, vals2),
+                              lambda a, b: a + b))
+    assert dict(res2.rows()) == reduce_oracle(keys2, vals2)
+    assert sess.executor.device_group_count() > before
+
+
+def test_resize_grow():
+    keys, vals = keyed_input()
+    sess = Session(executor=MeshExecutor(make_mesh(2)))
+    res1 = sess.run(bs.Reduce(bs.Const(2, keys, vals), lambda a, b: a + b))
+    sess.executor.resize(make_mesh(8))
+    assert sess.executor.nmesh == 8
+    assert dict(res1.rows()) == reduce_oracle(keys, vals)
+    keys2, vals2 = keyed_input(seed=2)
+    res2 = sess.run(bs.Reduce(bs.Const(8, keys2, vals2),
+                              lambda a, b: a + b))
+    assert dict(res2.rows()) == reduce_oracle(keys2, vals2)
+
+
+def test_resize_unsalvageable_outputs_marked_lost_and_recomputed():
+    keys, vals = keyed_input()
+    sess = Session(executor=MeshExecutor(make_mesh(8)))
+    res = sess.run(bs.Reduce(bs.Const(8, keys, vals), lambda a, b: a + b))
+
+    # Simulate device data dying with the old mesh: every un-gathered
+    # output raises on materialization.
+    ex = sess.executor
+    with ex._lock:
+        for out in ex._outputs.values():
+            waves = getattr(out, "waves", None)
+            for w in (waves if waves is not None else [out]):
+                w._chunks = None
+
+                def boom(self=w):
+                    raise RuntimeError("device gone")
+
+                w.host_chunks = boom
+    lost = ex.resize(make_mesh(4))
+    assert lost, "expected unreachable outputs to be marked LOST"
+    assert all(t.state == TaskState.LOST for t in lost)
+
+    # Reading the old Result re-evaluates lost producers on the NEW
+    # mesh (re-eval-before-read, exec/bigmachine.go:1485-1535 analog).
+    assert dict(res.rows()) == reduce_oracle(keys, vals)
+
+
+class _LossyExecutor(MeshExecutor):
+    """Raises a gang-loss error from device group launches number
+    ``fail_from`` .. ``fail_from+fail_times-1`` (0-based launch count) —
+    the simulated 'a host dropped out of the gang' failure."""
+
+    def __init__(self, mesh, fail_times=1, fail_from=0):
+        super().__init__(mesh)
+        self.fail_times = fail_times
+        self.fail_from = fail_from
+        self.launches = 0
+        self.resize_calls = []
+
+    def _execute_group(self, key, tasks):
+        i = self.launches
+        self.launches += 1
+        if self.fail_from <= i < self.fail_from + self.fail_times:
+            raise HostLostError("peer process lost (simulated)")
+        return super()._execute_group(key, tasks)
+
+    def resize(self, mesh):
+        self.resize_calls.append(int(mesh.devices.size))
+        return super().resize(mesh)
+
+
+def test_elastic_session_recovers_from_gang_loss():
+    keys, vals = keyed_input()
+    ex = _LossyExecutor(make_mesh(8), fail_times=1)
+    sess = Session(executor=ex, elastic=2,
+                   mesh_provider=lambda: make_mesh(4))
+    res = sess.run(bs.Reduce(bs.Const(8, keys, vals), lambda a, b: a + b))
+    assert dict(res.rows()) == reduce_oracle(keys, vals)
+    assert ex.resize_calls == [4]  # recovered onto the smaller mesh
+    assert ex.nmesh == 4
+    assert ex.device_group_count() >= 1  # retry used the device path
+
+
+def test_elastic_recovery_after_partial_completion():
+    """Gang loss AFTER earlier groups completed on the old mesh: their
+    salvaged outputs must feed new-mesh programs via host re-upload,
+    never zero-copy (old-mesh device arrays are incompatible with
+    programs shard_map'd over the new mesh)."""
+    keys, vals = keyed_input()
+    # Reduce compiles to (producer+combine group) -> (reduce group):
+    # fail the SECOND launch so the first group's output lives on the
+    # 8-mesh when recovery shrinks to 4.
+    ex = _LossyExecutor(make_mesh(8), fail_times=1, fail_from=1)
+    sess = Session(executor=ex, elastic=1,
+                   mesh_provider=lambda: make_mesh(4))
+    res = sess.run(bs.Reduce(bs.Const(8, keys, vals), lambda a, b: a + b))
+    assert dict(res.rows()) == reduce_oracle(keys, vals)
+    assert ex.resize_calls == [4]
+    assert ex.launches >= 2
+
+
+def test_elastic_exhausted_reraises():
+    keys, vals = keyed_input()
+    ex = _LossyExecutor(make_mesh(8), fail_times=10)
+    sess = Session(executor=ex, elastic=2,
+                   mesh_provider=lambda: make_mesh(8))
+    with pytest.raises(Exception) as ei:
+        sess.run(bs.Reduce(bs.Const(8, keys, vals), lambda a, b: a + b))
+    assert "peer process lost" in repr(ei.value)
+    assert len(ex.resize_calls) == 2  # used exactly `elastic` retries
+
+
+def test_non_gang_errors_do_not_trigger_elastic_retry():
+    def bad(x):
+        raise ValueError("app bug")
+
+    ex = _LossyExecutor(make_mesh(4), fail_times=0)
+    sess = Session(executor=ex, elastic=3,
+                   mesh_provider=lambda: make_mesh(2))
+    with pytest.raises(Exception) as ei:
+        sess.run(bs.Map(bs.Const(4, np.arange(8, dtype=np.int32)), bad,
+                        out=[np.int32]))
+    assert "app bug" in repr(ei.value)
+    assert ex.resize_calls == []  # application errors never resize
