@@ -75,14 +75,29 @@ class GlobalVocab:
         return len(self._values)
 
     def encode(self, col: Sequence) -> np.ndarray:
-        idx = self._index
-        out = np.empty(len(col), dtype=np.int32)
-        for i, v in enumerate(col):
-            code = idx.get(v)
-            if code is None:
-                raise KeyError(f"value {v!r} not in vocabulary")
-            out[i] = code
-        return out
+        # map + fromiter keeps the lookup loop in C (dict __getitem__
+        # raises KeyError on unknown values on its own).
+        return np.fromiter(
+            map(self._index.__getitem__, col), np.int32, len(col)
+        )
+
+    def encode_extending(self, col: Sequence) -> np.ndarray:
+        """Encode a column, assigning fresh codes to unseen values —
+        vocabulary build and encode fused into one locked pass (the
+        wordcount hot path: one hash probe per row instead of two)."""
+        with self._lock:
+            idx = self._index
+            vals = self._values
+            out = np.empty(len(col), dtype=np.int32)
+            for i, v in enumerate(col):
+                c = idx.get(v)
+                if c is None:
+                    c = len(vals)
+                    idx[v] = c
+                    vals.append(v)
+                out[i] = c
+            self._lookup = None
+            return out
 
     def decode(self, codes) -> np.ndarray:
         if self._lookup is None:

@@ -23,6 +23,28 @@ def _domain(url: str) -> str:
     return url.split("/", 1)[0].lower()
 
 
+def _add(a, b):
+    # Module-level (stable identity): device program/jit caches key on
+    # the combine fn's id, so repeated domain_count_encoded calls in
+    # one session reuse the compiled SPMD reduce.
+    return a + b
+
+
+def _attach_one(code):
+    return (code, 1)
+
+
+def _domains_batch(urls) -> np.ndarray:
+    """Batch ``_domain`` over a whole column. Deliberately a list
+    comprehension, not np.char: for short strings the fixed-width
+    unicode round-trips np.char needs cost ~4× the C-dispatched str
+    methods (measured in the wordcount bench profile); must stay
+    bit-equal to _domain — tests/test_models.py pins the equivalence."""
+    out = np.empty(len(urls), dtype=object)
+    out[:] = [_domain(u) for u in urls]
+    return out
+
+
 def domain_count(num_shards: int, source: Union[str, Callable]) -> bs.Slice:
     """Count URLs per domain (host-tier strings)."""
     lines = bs.ScanReader(num_shards, source)
@@ -44,19 +66,25 @@ def domain_count_encoded(sess, num_shards: int,
     lines = bs.ScanReader(num_shards, source)
     vocab = dictenc.GlobalVocab()
 
-    def collect(shard, frame):
-        vocab.extend(_domain(u) for u in frame.cols[0])
+    # Pass 1 — ONE host sweep: parse, build the vocabulary, and encode
+    # in the same batch fn; the materialized corpus is int32 CODES, so
+    # everything downstream (count attach, hash, shuffle, combine) is
+    # device-tier. (Earlier shapes parsed twice and re-read host
+    # strings; the host sweep is this config's Amdahl term, so it runs
+    # exactly once.)
+    def parse_encode(f):
+        return (vocab.encode_extending(_domains_batch(f.cols[0])),)
 
-    # Vocabulary pass: materializing the WriterFunc drives every batch
-    # through `collect` — and the Result keeps the corpus, so pass 2
-    # reuses it instead of re-reading the source (ScanReader striping
-    # would otherwise cost num_shards full scans again).
-    corpus = sess.run(bs.WriterFunc(lines, collect))
+    corpus = sess.run(bs.MapBatches(lines, parse_encode, out=[np.int32]))
     try:
-        pairs = bs.Map(corpus, lambda u: (_domain(u), 1),
-                       out=[str, np.int32])
-        return dictenc.dict_encoded_reduce(
-            sess, pairs, lambda a, b: a + b, vocab
-        )
+        # Pass 2 — all device: attach unit counts (traced Map), reduce.
+        pairs = bs.Map(corpus, _attach_one, out=[np.int32, np.int32])
+        res = sess.run(bs.Reduce(pairs, _add))
+        out = []
+        for f in res.frames():
+            f = dictenc.decode_frame_column(f.to_host(), 0, vocab)
+            out.extend(f.rows())
+        res.discard()
+        return out
     finally:
         corpus.discard()

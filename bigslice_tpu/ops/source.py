@@ -97,16 +97,25 @@ class ScanReader(Slice):
                     yield line.rstrip("\n")
 
     def reader(self, shard, deps):
+        from bigslice_tpu.frame.frame import obj_col
+
+        def frame_of(lines):
+            return Frame([obj_col(lines)], self.schema)
+
         def read():
-            batch = []
-            for i, line in enumerate(self._lines()):
-                if i % self.num_shards != shard:
-                    continue
-                batch.append((line,))
-                if len(batch) >= sliceio.DEFAULT_CHUNK_ROWS:
-                    yield Frame.from_rows(batch, self.schema)
-                    batch = []
-            if batch:
-                yield Frame.from_rows(batch, self.schema)
+            import itertools
+
+            ns = self.num_shards
+            it = self._lines()
+            if ns > 1:
+                # Striping: keep lines i % ns == shard.
+                it = itertools.islice(it, shard, None, ns)
+            while True:
+                batch = list(itertools.islice(
+                    it, sliceio.DEFAULT_CHUNK_ROWS
+                ))
+                if not batch:
+                    return
+                yield frame_of(batch)
 
         return read()

@@ -106,6 +106,20 @@ def test_urls_domain_count(tmp_path):
     assert got == {"a.com": 2, "b.org": 2, "c.net": 1}
 
 
+def test_domains_batch_matches_scalar():
+    import bigslice_tpu.models.urls as urls_mod
+
+    cases = [
+        "http://A.com/x/y", "https://b.org/", "c.net", "c.net/",
+        "HTTP://UPPER.COM", "ftp://f.io/a//b", "//bare.host/p",
+        "no-scheme/with/path", "", "http://", "a//b/c",
+    ]
+    got = urls_mod._domains_batch(cases).tolist()
+    want = [urls_mod._domain(u) for u in cases]
+    assert got == want
+    assert urls_mod._domains_batch([]).tolist() == []
+
+
 def test_urls_domain_count_encoded(tmp_path):
     import bigslice_tpu.models.urls as urls_mod
 

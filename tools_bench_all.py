@@ -33,8 +33,10 @@ def record(entry: dict) -> None:
 
 
 def already_measured() -> set:
-    """Bench names already recorded with a value: a retried sweep after
-    a mid-run wedge skips them instead of re-paying compiles."""
+    """Bench names recorded with a value SINCE the last completed sweep:
+    a retried sweep after a mid-run wedge skips them instead of
+    re-paying compiles, while a fresh sweep after a DONE sentinel
+    re-measures everything."""
     done = set()
     try:
         with open(OUT) as fp:
@@ -43,7 +45,9 @@ def already_measured() -> set:
                     e = json.loads(line)
                 except ValueError:
                     continue
-                if "value" in e:
+                if e.get("bench") == "DONE":
+                    done.clear()
+                elif "value" in e:
                     done.add(e["bench"])
     except OSError:
         pass
