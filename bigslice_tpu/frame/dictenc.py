@@ -75,11 +75,15 @@ class GlobalVocab:
         return len(self._values)
 
     def encode(self, col: Sequence) -> np.ndarray:
-        # map + fromiter keeps the lookup loop in C (dict __getitem__
-        # raises KeyError on unknown values on its own).
-        return np.fromiter(
-            map(self._index.__getitem__, col), np.int32, len(col)
-        )
+        # map + fromiter keeps the lookup loop in C.
+        try:
+            return np.fromiter(
+                map(self._index.__getitem__, col), np.int32, len(col)
+            )
+        except KeyError as e:
+            raise KeyError(
+                f"value {e.args[0]!r} not in vocabulary"
+            ) from None
 
     def encode_extending(self, col: Sequence) -> np.ndarray:
         """Encode a column, assigning fresh codes to unseen values —
@@ -126,6 +130,21 @@ def decode_frame_column(frame: Frame, col_index: int,
     return Frame(cols, Schema(types, frame.schema.prefix))
 
 
+def decode_result_rows(res, vocab: GlobalVocab,
+                       col_index: int = 0) -> List[Tuple]:
+    """Collect a Result's rows with one code column decoded through the
+    vocabulary; the Result's buffers are discarded afterwards even when
+    a read/decode fails mid-stream."""
+    out = []
+    try:
+        for f in res.frames():
+            f = decode_frame_column(f.to_host(), col_index, vocab)
+            out.extend(f.rows())
+    finally:
+        res.discard()
+    return out
+
+
 def dict_encoded_reduce(sess, slice_, combine_fn, vocab: GlobalVocab):
     """Reduce a (host_key, *device_vals) slice entirely on the device
     tier: encode keys to codes, shuffle/combine on device, decode on
@@ -143,8 +162,4 @@ def dict_encoded_reduce(sess, slice_, combine_fn, vocab: GlobalVocab):
         out=[np.int32] + [c for c in slice_.schema.cols[1:]],
     )
     res = sess.run(bs.Reduce(encoded, combine_fn))
-    out = []
-    for f in res.frames():
-        f = decode_frame_column(f.to_host(), 0, vocab)
-        out.extend(f.rows())
-    return out
+    return decode_result_rows(res, vocab)

@@ -58,8 +58,10 @@ def domain_count_encoded(sess, num_shards: int,
                          ) -> List[Tuple[str, int]]:
     """Count URLs per domain with device-tier counting.
 
-    Pass 1 (host, streaming): collect the domain vocabulary.
-    Pass 2: encode per batch (vectorized) and Reduce on device.
+    Pass 1 (host, streaming): parse, build the vocabulary, and encode
+    in one fused sweep, materializing int32 codes.
+    Pass 2 (device): attach unit counts and Reduce over the codes;
+    decode at the edge.
     """
     from bigslice_tpu.frame import dictenc
 
@@ -80,11 +82,6 @@ def domain_count_encoded(sess, num_shards: int,
         # Pass 2 — all device: attach unit counts (traced Map), reduce.
         pairs = bs.Map(corpus, _attach_one, out=[np.int32, np.int32])
         res = sess.run(bs.Reduce(pairs, _add))
-        out = []
-        for f in res.frames():
-            f = dictenc.decode_frame_column(f.to_host(), 0, vocab)
-            out.extend(f.rows())
-        res.discard()
-        return out
+        return dictenc.decode_result_rows(res, vocab)
     finally:
         corpus.discard()

@@ -600,15 +600,43 @@ def shard_columns(mesh, cols: Sequence[np.ndarray], counts: Sequence[int],
 
 def unshard_columns(cols: Sequence, counts, capacity: int) -> List[List[np.ndarray]]:
     """Inverse of shard_columns: global padded arrays → per-shard valid
-    host chunks."""
+    host chunks.
+
+    Device-resident columns transfer only each shard's valid prefix
+    (rounded up to a power-of-two bucket so the tiny slice programs
+    don't thrash the compile cache): combiner outputs are typically far
+    smaller than their padded capacity, and on TPU the readback rides
+    the host link — moving ``capacity`` rows to read ``count`` is the
+    difference between a result scan and a full-buffer download."""
     counts = np.asarray(counts)
     nshards = len(counts)
-    out = []
-    for c in cols:
-        c = np.asarray(c)
-        chunks = []
-        for s in range(nshards):
-            start = s * capacity
-            chunks.append(c[start : start + int(counts[s])])
-        out.append(chunks)
-    return out
+    return [_valid_chunks(c, counts, capacity, nshards) for c in cols]
+
+
+def _valid_chunks(c, counts, capacity: int, nshards: int) -> List[np.ndarray]:
+    from bigslice_tpu.parallel.jitutil import bucket_size
+
+    shards = getattr(c, "addressable_shards", None)
+    if shards is not None and len(shards) == nshards:
+        by_row = {}
+        for sh in shards:
+            start = sh.index[0].start or 0
+            if start % capacity == 0:
+                by_row[start // capacity] = sh.data
+        if set(by_row) == set(range(nshards)):
+            chunks = []
+            for s in range(nshards):
+                k = int(counts[s])
+                if k == 0:
+                    chunks.append(np.empty(
+                        (0,) + tuple(c.shape[1:]), c.dtype
+                    ))
+                    continue
+                b = min(capacity, bucket_size(k))
+                chunks.append(np.asarray(by_row[s][:b])[:k])
+            return chunks
+    # Host columns / multi-process gathers (already numpy) / unexpected
+    # layouts: the plain full-copy path.
+    c = np.asarray(c)
+    return [c[s * capacity : s * capacity + int(counts[s])]
+            for s in range(nshards)]
