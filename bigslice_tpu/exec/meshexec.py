@@ -827,13 +827,14 @@ class MeshExecutor:
                 np.int32(wave), *counts_list, *cols_flat, *extras
             )
             has_shuffle = any(k == "shuffle" for k, _, _ in stages)
-            if has_shuffle and int(np.asarray(badrange)) > 0:
+            if int(np.asarray(badrange)) > 0:
                 # User error, not skew: match the host tier's range
                 # check (exec/local.py partition_frame) instead of
                 # burning slack retries.
                 raise ValueError(
                     f"partitioner returned ids outside "
-                    f"[0, {task0.num_partition}) in group "
+                    f"[0, {task0.num_partition}), or keys outside the "
+                    f"declared dense_keys range, in group "
                     f"{task0.name.op}"
                 )
             if not has_shuffle or int(np.asarray(overflow)) == 0:
@@ -848,11 +849,10 @@ class MeshExecutor:
                 )
             slack = min(slack * 4, full_slack)
             self._slack_memo[_op_base(task0.name.op)] = slack
-        out_capacity = (
-            self.nmesh
-            * shuffle_mod.send_capacity(base_capacity, ndest, slack)
-            if has_shuffle else base_capacity
-        )
+        # Per-device stride of the (front-packed) output buffers —
+        # derived from the actual global shape, which is authoritative
+        # for every lowering (sort shuffle, dense tables, pass-through).
+        out_capacity = int(out_cols[0].shape[0]) // self.nmesh
         return DeviceGroupOutput(
             list(out_cols), out_counts, out_capacity, task0.schema,
             partitioned=task0.num_partition > 1,
@@ -1030,8 +1030,12 @@ class MeshExecutor:
                 stages.append(("head", s.n, s))
             elif isinstance(s, Reduce):
                 fc = s.frame_combiner
-                stages.append(("combine", (id(fc.fn), fc.nkeys, fc.nvals),
-                               s))
+                stages.append((
+                    "combine",
+                    (id(fc.fn), fc.nkeys, fc.nvals,
+                     getattr(fc, "dense_keys", None)),
+                    s,
+                ))
             elif isinstance(s, Fold):
                 stages.append((
                     "fold",
@@ -1055,7 +1059,8 @@ class MeshExecutor:
                 "shuffle",
                 (task.schema.prefix, id(fc.fn) if fc else None,
                  id(pf.fn) if pf is not None else None,
-                 task.num_partition),
+                 task.num_partition,
+                 getattr(fc, "dense_keys", None) if fc else None),
                 task,
             ))
         return stages
@@ -1205,10 +1210,43 @@ class MeshExecutor:
                     mask = mask & (rank <= s.n)
                 elif kind == "combine":
                     fc = s.frame_combiner
-                    core = segment.make_segmented_reduce_masked(
-                        fc.nkeys, fc.nvals,
-                        segment.canonical_combine(fc.fn, fc.nvals),
-                    )
+                    dk = getattr(fc, "dense_keys", None)
+                    # Dense only while the table is in the same league
+                    # as the input: a K-row table (and the K-row
+                    # compaction after it) must not dwarf an input the
+                    # segmented reduce would handle in O(n log n) —
+                    # e.g. the post-shuffle combine of a dense producer
+                    # sees ~K/nmesh rows; a full-K table per device
+                    # would re-inflate the pipeline. Static decision:
+                    # shapes are compile-time.
+                    if dk is not None and dk > 2 * cols[0].shape[0]:
+                        dk = None
+                    if dk is not None:
+                        # Dense-coded keys: scatter-accumulate table
+                        # instead of sort+segmented-scan. Out-of-range
+                        # keys count into the bad signal (checked
+                        # whether or not a shuffle follows).
+                        from bigslice_tpu.parallel import (
+                            dense as dense_mod,
+                        )
+                        from jax import lax as _lax
+
+                        key_col = cols[0]
+                        badrange = badrange + _lax.psum(
+                            jnp.sum((mask & ((key_col < 0)
+                                             | (key_col >= dk))
+                                     ).astype(np.int32)),
+                            axis,
+                        )
+                        core = dense_mod.make_dense_combine(
+                            dk, fc.dense_ops,
+                            [ct.dtype for ct in s.schema.values],
+                        )
+                    else:
+                        core = segment.make_segmented_reduce_masked(
+                            fc.nkeys, fc.nvals,
+                            segment.canonical_combine(fc.fn, fc.nvals),
+                        )
                     mask, keys, vals = core(
                         mask, tuple(cols[: fc.nkeys]),
                         tuple(cols[fc.nkeys :]),
@@ -1241,7 +1279,27 @@ class MeshExecutor:
                     pf = part.partition_fn
                     pfn = (pf.device_fn(s.num_partition)
                            if pf is not None else None)
-                    if fc is not None and fc.nkeys == nkeys:
+                    dense_k = (getattr(fc, "dense_keys", None)
+                               if fc is not None else None)
+                    if (dense_k is not None and pf is None
+                            and nkeys == 1
+                            and s.num_partition == nmesh):
+                        # Dense-coded keys: sort-free table combine +
+                        # static-routed all_to_all (parallel/dense.py).
+                        from bigslice_tpu.parallel import (
+                            dense as dense_mod,
+                        )
+
+                        body = dense_mod.make_dense_combine_shuffle(
+                            nmesh, dense_k, fc.dense_ops,
+                            [ct.dtype for ct in s.schema.values],
+                            axis,
+                        )
+                        mask, ov, nb, cols = body.masked(mask, *cols)
+                        cols = list(cols)
+                        overflow = overflow + ov
+                        badrange = badrange + nb
+                    elif fc is not None and fc.nkeys == nkeys:
                         # Combiner-bearing shuffle: the fused kernel's
                         # single (validity, dest, keys) sort replaces
                         # the combine sort + routing sort pair.

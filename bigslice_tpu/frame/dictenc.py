@@ -161,5 +161,8 @@ def dict_encoded_reduce(sess, slice_, combine_fn, vocab: GlobalVocab):
         lambda f: [vocab.encode(f.cols[0])] + list(f.cols[1:]),
         out=[np.int32] + [c for c in slice_.schema.cols[1:]],
     )
-    res = sess.run(bs.Reduce(encoded, combine_fn))
+    # Codes are dense in [0, len(vocab)) by construction — declare it
+    # so the mesh executor can take the sort-free dense-table lowering.
+    res = sess.run(bs.Reduce(encoded, combine_fn,
+                             dense_keys=max(1, len(vocab))))
     return decode_result_rows(res, vocab)

@@ -79,9 +79,12 @@ def domain_count_encoded(sess, num_shards: int,
 
     corpus = sess.run(bs.MapBatches(lines, parse_encode, out=[np.int32]))
     try:
-        # Pass 2 — all device: attach unit counts (traced Map), reduce.
+        # Pass 2 — all device: attach unit counts (traced Map), then a
+        # dense-keyed Reduce (codes are in [0, len(vocab)) by
+        # construction — the sort-free table lowering applies).
         pairs = bs.Map(corpus, _attach_one, out=[np.int32, np.int32])
-        res = sess.run(bs.Reduce(pairs, _add))
+        res = sess.run(bs.Reduce(pairs, _add,
+                                 dense_keys=max(1, len(vocab))))
         return dictenc.decode_result_rows(res, vocab)
     finally:
         corpus.discard()

@@ -58,7 +58,8 @@ class FrameCombiner:
     """Combines frames by key; device kernel when possible, host dict
     otherwise. This is what executors invoke for map-side combining."""
 
-    def __init__(self, fn: Callable, schema: Schema):
+    def __init__(self, fn: Callable, schema: Schema,
+                 dense_keys: Optional[int] = None):
         self.fn = fn
         self.schema = schema
         self.nkeys = schema.prefix
@@ -71,6 +72,31 @@ class FrameCombiner:
             if self.device
             else None
         )
+        # Dense-key declaration (parallel/dense.py): keys are int32
+        # codes in [0, dense_keys). dense_ops is the per-column
+        # add/max/min classification; None (fn unclassifiable, wrong
+        # key shape/dtype, host tier) quietly keeps the sort lowering.
+        self.dense_keys = None
+        self.dense_ops = None
+        if (dense_keys is not None and self.device and self.nkeys == 1
+                and np.dtype(schema.cols[0].dtype) == np.dtype(np.int32)
+                and schema.cols[0].shape == ()):
+            from bigslice_tpu.parallel import dense
+
+            ops = None
+            # Oversized/invalid bounds quietly keep the sort path
+            # (callers derive the bound from data size — e.g.
+            # dictenc's len(vocab) — and must not start crashing when
+            # the data grows past the table cap).
+            if (0 < dense_keys <= dense.MAX_DENSE_KEYS
+                    and all(ct.shape == () for ct in schema.values)):
+                ops = dense.classified_ops_cached(
+                    fn, self.nvals,
+                    tuple(np.dtype(ct.dtype) for ct in schema.values),
+                )
+            if ops is not None:
+                self.dense_keys = int(dense_keys)
+                self.dense_ops = ops
 
     def combine(self, frame: Frame) -> Frame:
         """Combine equal keys within one frame."""
@@ -95,7 +121,15 @@ class FrameCombiner:
 
 
 class Reduce(Slice):
-    def __init__(self, slice_: Slice, fn: Callable):
+    def __init__(self, slice_: Slice, fn: Callable,
+                 dense_keys: Optional[int] = None):
+        """``dense_keys``: optional declaration that the (single int32)
+        key column holds dense codes in ``[0, dense_keys)`` —
+        dictionary encodings, categorical ids. When the combine fn
+        classifies as per-column add/max/min, the mesh executor lowers
+        the combine+shuffle to the sort-free dense-table path
+        (parallel/dense.py); otherwise the declaration is ignored.
+        Keys outside the declared range fail the run loudly."""
         typecheck.check(
             slice_.prefix >= 1, "reduce: input slice must have a key prefix"
         )
@@ -115,7 +149,8 @@ class Reduce(Slice):
         self.dep_slice = slice_
         self.fn = fn
         self._combiner = Combiner(fn, name="reduce")
-        self.frame_combiner = FrameCombiner(fn, slice_.schema)
+        self.frame_combiner = FrameCombiner(fn, slice_.schema,
+                                            dense_keys=dense_keys)
 
     def deps(self):
         return (Dep(self.dep_slice, shuffle=True, partitioner=None,

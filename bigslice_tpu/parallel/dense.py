@@ -1,0 +1,263 @@
+"""Dense-keyed combine + shuffle: the sort-free reduce path.
+
+When a Reduce's keys are dense int32 codes in ``[0, K)`` — dictionary
+encodings (frame/dictenc.py), categorical ids, bucketed features — the
+sort-dominated combine+shuffle pipeline (parallel/shuffle.py
+make_combine_shuffle_fn; BASELINE.md roofline) collapses to:
+
+  1. per-shard dense value tables, one scatter-accumulate pass over the
+     rows (no sorts, no overflow slack, no retries);
+  2. ONE all_to_all of the tables, pre-gathered through a *static*
+     routing permutation so each device receives exactly the table
+     slots of its own partition;
+  3. an elementwise reduction over the received per-shard planes.
+
+This is the BASELINE north star's "combiners lower to
+psum/reduce-scatter" realized literally (an all_to_all + local reduce
+is reduce_scatter generalized to max/min). The routing permutation is
+computed from the SAME ``partition_ids`` contract as the sorting
+shuffle — key k lands on the same device under either lowering, so
+consumers (including other deps of a Cogroup/JoinAggregate compiled
+through the sort path) stay aligned.
+
+Eligibility is decided by the executor (meshexec): single int32 key,
+a declared ``dense_keys`` bound, a combine fn that classifies as
+per-column add/max/min (``classify_combine_ops``), no custom
+partitioner. Keys outside ``[0, K)`` raise through the shuffle's
+bad-partition signal rather than silently dropping.
+
+The reference has no analog (its combiningFrame is always a hash
+table, exec/combiner.go:56-99); this is a TPU-first specialization the
+hardware rewards: scatter-accumulate + collectives instead of
+comparison sorts.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+# Largest declared key space the dense path accepts: beyond this the
+# per-shard tables (K rows x nvals columns) start competing with the
+# data itself for memory and the sort pipeline wins anyway.
+MAX_DENSE_KEYS = 1 << 22
+
+
+def classify_combine_ops(cfn, val_dtypes: Sequence) -> Optional[Tuple[str, ...]]:
+    """Classify a canonical combine fn as per-column ('add'|'max'|'min')
+    by probing it on random vectors of the actual value dtypes; None
+    when any column doesn't match (the sort path handles it).
+
+    A user fn that equals one of the candidates on 64 random pairs per
+    column but diverges elsewhere is implausible; cross-column fns
+    (col j reading side b's column i) diverge on the probe and
+    classify None.
+    """
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    n = 64
+
+    def sample(dt):
+        dt = np.dtype(dt)
+        if dt.kind == "f":
+            return (rng.randn(n) * 8).astype(dt)
+        if dt.kind in "iu":
+            lo, hi = (-(1 << 15), 1 << 15) if dt.kind == "i" else (0, 1 << 16)
+            return rng.randint(lo, hi, n).astype(dt)
+        return None
+
+    a = [sample(dt) for dt in val_dtypes]
+    b = [sample(dt) for dt in val_dtypes]
+    if any(x is None for x in a):
+        return None
+    try:
+        import jax
+
+        # Probe scalar-wise under vmap — the same application shape the
+        # segment kernels use, so anything the device tier accepts
+        # classifies consistently.
+        out = jax.vmap(lambda xs, ys: cfn(xs, ys))(
+            tuple(jnp.asarray(x) for x in a),
+            tuple(jnp.asarray(x) for x in b),
+        )
+        out = [np.asarray(o) for o in out]
+    except Exception:
+        return None
+    ops = []
+    for x, y, o in zip(a, b, out):
+        if o.dtype != x.dtype or o.shape != x.shape:
+            return None
+        if np.array_equal(o, x + y):
+            ops.append("add")
+        elif np.array_equal(o, np.maximum(x, y)):
+            ops.append("max")
+        elif np.array_equal(o, np.minimum(x, y)):
+            ops.append("min")
+        else:
+            return None
+    return tuple(ops)
+
+
+@functools.lru_cache(maxsize=256)
+def classified_ops_cached(fn, nvals: int,
+                          val_dtypes: tuple) -> Optional[Tuple[str, ...]]:
+    """Memoized classify_combine_ops keyed on the fn object + value
+    dtypes: iterative drivers rebuild Reduce slices every round (the
+    id(fn)-keyed program caches depend on exactly that), and the vmap
+    probe must not recur per step. The cache pins fn, like the program
+    caches do."""
+    from bigslice_tpu.parallel import segment
+
+    return classify_combine_ops(
+        segment.canonical_combine(fn, nvals), list(val_dtypes)
+    )
+
+
+def _identity(op: str, dtype) -> np.generic:
+    dt = np.dtype(dtype)
+    if op == "add":
+        return dt.type(0)
+    if op == "max":
+        return dt.type(-np.inf) if dt.kind == "f" else np.iinfo(dt).min
+    if op == "min":
+        return dt.type(np.inf) if dt.kind == "f" else np.iinfo(dt).max
+    raise ValueError(op)
+
+
+@functools.lru_cache(maxsize=32)
+def routing_tables(K: int, nparts: int, seed: int) -> Tuple[np.ndarray, int]:
+    """Static slot routing: ``slot_table[p]`` lists the keys owned by
+    partition p (padded with the ``K`` sentinel), under the SAME
+    hash-routing contract as the sorting shuffle (partition_ids with
+    the stock XLA path — bit-identical to the Pallas tier by the
+    mosaic gate). Returns (slot_table int32[nparts, maxc], maxc)."""
+    from bigslice_tpu.parallel import shuffle as shuffle_mod
+
+    keys = np.arange(K, dtype=np.int32)
+    part, _, _ = shuffle_mod.partition_ids(
+        (keys,), nparts, seed, use_pallas=False
+    )
+    part = np.asarray(part)
+    order = np.argsort(part, kind="stable")
+    counts = np.bincount(part, minlength=nparts)[:nparts]
+    maxc = max(int(counts.max()) if K else 0, 1)
+    slot_table = np.full((nparts, maxc), K, dtype=np.int32)
+    start = 0
+    for p in range(nparts):
+        c = int(counts[p])
+        slot_table[p, :c] = order[start : start + c]
+        start += c
+    return slot_table, maxc
+
+
+def make_dense_combine(K: int, ops: Tuple[str, ...],
+                       val_dtypes: Sequence):
+    """Shuffle-free dense combine for a single partition (or the
+    map-side stage of a 1-device mesh): one scatter-accumulate pass
+    into a [K] table, unpacked to (key, vals) rows under a presence
+    mask. ``masked(valid, key, *vals) -> (mask, (key,), vals)`` — the
+    make_segmented_reduce_masked contract (output size K instead of the
+    input size; downstream mask-chaining handles both)."""
+    import jax.numpy as jnp
+
+    idents = [_identity(op, dt) for op, dt in zip(ops, val_dtypes)]
+
+    def masked(valid, keys, vals):
+        (key,) = keys
+        in_range = (key >= 0) & (key < K)
+        # Out-of-range keys route to the drop lane; the CALLER counts
+        # them into the pipeline's bad signal (this contract has no
+        # channel for it) so declared-range violations still fail the
+        # run loudly instead of dropping rows.
+        idx = jnp.where(valid & in_range, key, np.int32(K))
+        present = jnp.zeros((K + 1,), bool).at[idx].set(True)
+        out_vals = []
+        for v, op, ident in zip(vals, ops, idents):
+            t = jnp.full((K + 1,), ident, v.dtype)
+            upd = t.at[idx]
+            t = (upd.add(v) if op == "add"
+                 else upd.max(v) if op == "max"
+                 else upd.min(v))
+            out_vals.append(t[:K])
+        out_key = jnp.arange(K, dtype=np.int32)
+        return present[:K], (out_key,), tuple(out_vals)
+
+    return masked
+
+
+def make_dense_combine_shuffle(nmesh: int, K: int, ops: Tuple[str, ...],
+                               val_dtypes: Sequence, axis: str,
+                               seed: int = 0):
+    """Build the dense lowering; ``.masked(valid, key, *vals)`` returns
+    ``(recv_valid_mask, overflow, bad, out_cols)`` — the same contract
+    as make_combine_shuffle_fn(...).masked (out_cols = key column then
+    value columns, front-packing deferred to the caller's compaction).
+    Output capacity per device is ``maxc`` rows."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    slot_table_np, maxc = routing_tables(K, nmesh, seed)
+    idents = [_identity(op, dt) for op, dt in zip(ops, val_dtypes)]
+
+    def masked(valid, key, *vals):
+        slot_table = jnp.asarray(slot_table_np)
+        in_range = (key >= 0) & (key < K)
+        # psum: the caller reads bad/overflow through a replicated out
+        # spec, which takes one device's copy — every device must hold
+        # the global count.
+        bad = lax.psum(
+            jnp.sum((valid & ~in_range).astype(np.int32)), axis
+        )
+        idx = jnp.where(valid & in_range, key, np.int32(K))
+
+        # 1. Per-shard dense tables: one scatter-accumulate pass (the
+        # K-th row is the drop lane for invalid/out-of-range rows).
+        present = jnp.zeros((K + 1,), bool).at[idx].set(
+            True, mode="drop"
+        )
+        tables = []
+        for v, op, ident in zip(vals, ops, idents):
+            t = jnp.full((K + 1,), ident, v.dtype)
+            upd = t.at[idx]
+            t = (upd.add(v, mode="drop") if op == "add"
+                 else upd.max(v, mode="drop") if op == "max"
+                 else upd.min(v, mode="drop"))
+            tables.append(t)
+
+        # 2. Gather through the static routing permutation, then ONE
+        # all_to_all: device p receives every shard's partition-p
+        # plane.
+        def route(x):
+            planes = x[slot_table]  # [nmesh, maxc]
+            return lax.all_to_all(planes, axis, split_axis=0,
+                                  concat_axis=0, tiled=True)
+
+        recv_present = route(present)          # [nmesh, maxc]
+        recv_tables = [route(t) for t in tables]
+
+        # 3. Elementwise reduce over the shard planes.
+        present_any = jnp.any(recv_present, axis=0)
+        out_vals = []
+        for r, op in zip(recv_tables, ops):
+            out_vals.append(
+                jnp.sum(r, axis=0) if op == "add"
+                else jnp.max(r, axis=0) if op == "max"
+                else jnp.min(r, axis=0)
+            )
+        my_slots = slot_table[lax.axis_index(axis)]  # [maxc]
+        mask = present_any & (my_slots != K)
+        # Identity values never leak: masked rows are dropped by the
+        # caller's compaction before any consumer sees them.
+        return mask, jnp.int32(0), bad, (my_slots, *out_vals)
+
+    class _Body:
+        pass
+
+    body = _Body()
+    body.masked = masked
+    body.capacity = maxc
+    return body

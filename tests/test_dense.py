@@ -1,0 +1,233 @@
+"""Dense-keyed Reduce: the sort-free table+collective lowering
+(parallel/dense.py) must agree exactly with the sort pipeline and with
+the host oracle, across ops, dtypes, shard counts, and misdeclaration."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import bigslice_tpu as bs
+from bigslice_tpu.exec.meshexec import MeshExecutor
+from bigslice_tpu.exec.session import Session
+from bigslice_tpu.parallel import dense
+from bigslice_tpu.parallel import segment
+
+
+@pytest.fixture
+def mesh():
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(jax.devices()[:8]), ("shards",))
+
+
+def mesh_sess(mesh):
+    return Session(executor=MeshExecutor(mesh))
+
+
+# ---------------------------------------------------------- classifier
+
+def canon(fn, nvals):
+    return segment.canonical_combine(fn, nvals)
+
+
+def test_classify_add_max_min():
+    assert dense.classify_combine_ops(
+        canon(lambda a, b: a + b, 1), [np.int32]) == ("add",)
+    assert dense.classify_combine_ops(
+        canon(jnp.maximum, 1), [np.float32]) == ("max",)
+    assert dense.classify_combine_ops(
+        canon(jnp.minimum, 1), [np.int32]) == ("min",)
+
+
+def test_classify_per_column_mix():
+    def fn(a, b):
+        return (a[0] + b[0], jnp.maximum(a[1], b[1]))
+
+    assert dense.classify_combine_ops(
+        canon(fn, 2), [np.int32, np.float32]) == ("add", "max")
+
+
+def test_classify_rejects_nonstandard():
+    assert dense.classify_combine_ops(
+        canon(lambda a, b: a * b, 1), [np.int32]) is None
+    # Cross-column dependence must not classify.
+    assert dense.classify_combine_ops(
+        canon(lambda a, b: (a[0] + b[1], a[1] + b[0]), 2),
+        [np.int32, np.int32]) is None
+
+
+def test_routing_matches_sort_path_hash():
+    from bigslice_tpu.parallel import shuffle as shuffle_mod
+
+    K, P = 1000, 8
+    table, maxc = dense.routing_tables(K, P, 0)
+    part, _, _ = shuffle_mod.partition_ids(
+        (np.arange(K, dtype=np.int32),), P, 0, use_pallas=False
+    )
+    part = np.asarray(part)
+    for p in range(P):
+        slots = table[p][table[p] != K]
+        assert set(slots.tolist()) == set(
+            np.flatnonzero(part == p).tolist()
+        )
+    assert table.shape == (P, maxc)
+
+
+# ------------------------------------------------------------- e2e mesh
+
+def oracle(keys, vals, op):
+    out = {}
+    f = {"add": lambda a, b: a + b, "max": max, "min": min}[op]
+    for k, v in zip(keys.tolist(), vals.tolist()):
+        out[k] = f(out[k], v) if k in out else v
+    return out
+
+
+@pytest.mark.parametrize("op,fn", [
+    ("add", lambda a, b: a + b),
+    ("max", jnp.maximum),
+    ("min", jnp.minimum),
+])
+def test_dense_reduce_matches_oracle(mesh, op, fn):
+    rng = np.random.RandomState(3)
+    K = 500
+    keys = rng.randint(0, K, 6000).astype(np.int32)
+    vals = rng.randint(-100, 100, 6000).astype(np.int32)
+    sess = mesh_sess(mesh)
+    r = bs.Reduce(bs.Const(8, keys, vals), fn, dense_keys=K)
+    assert r.frame_combiner.dense_keys == K
+    res = sess.run(r)
+    assert dict(res.rows()) == oracle(keys, vals, op)
+    assert sess.executor.device_group_count() >= 1
+
+
+def test_dense_matches_sort_path_exactly(mesh):
+    rng = np.random.RandomState(4)
+    K = 300
+    keys = rng.randint(0, K, 4000).astype(np.int32)
+    vals = rng.randn(4000).astype(np.float32)
+
+    def add(a, b):
+        return a + b
+
+    dense_res = mesh_sess(mesh).run(
+        bs.Reduce(bs.Const(8, keys, vals), add, dense_keys=K))
+    sort_res = mesh_sess(mesh).run(
+        bs.Reduce(bs.Const(8, keys, vals), add))
+    d = dict(dense_res.rows())
+    s = dict(sort_res.rows())
+    assert set(d) == set(s)
+    for k in d:
+        # Both reassociate float adds; equal up to accumulation order.
+        assert abs(d[k] - s[k]) < 1e-3
+
+
+def test_dense_multi_value_mixed_ops(mesh):
+    def fn(a, b):
+        return (a[0] + b[0], jnp.maximum(a[1], b[1]))
+
+    rng = np.random.RandomState(5)
+    K = 64
+    keys = rng.randint(0, K, 3000).astype(np.int32)
+    v1 = rng.randint(0, 50, 3000).astype(np.int32)
+    v2 = rng.randn(3000).astype(np.float32)
+    r = bs.Reduce(bs.Const(8, keys, v1, v2), fn, dense_keys=K)
+    assert r.frame_combiner.dense_ops == ("add", "max")
+    res = mesh_sess(mesh).run(r)
+    got = {k: (a, b) for k, a, b in res.rows()}
+    want = {}
+    for k, a, b in zip(keys.tolist(), v1.tolist(), v2.tolist()):
+        if k in want:
+            want[k] = (want[k][0] + a, max(want[k][1], b))
+        else:
+            want[k] = (a, b)
+    assert set(got) == set(want)
+    for k in want:
+        assert got[k][0] == want[k][0]
+        assert abs(got[k][1] - want[k][1]) < 1e-6
+
+
+def test_unclassifiable_fn_ignores_dense_hint(mesh):
+    r = bs.Reduce(
+        bs.Const(8, np.arange(100, dtype=np.int32) % 7,
+                 np.ones(100, np.int32)),
+        lambda a, b: a * b, dense_keys=7,
+    )
+    assert r.frame_combiner.dense_keys is None  # sort path
+    res = mesh_sess(mesh).run(r)
+    assert dict(res.rows()) == {k: 1 for k in range(7)}
+
+
+def test_out_of_range_keys_fail_loudly(mesh):
+    keys = np.array([0, 1, 2, 99], dtype=np.int32)  # 99 >= K
+    r = bs.Reduce(bs.Const(8, keys, np.ones(4, np.int32)),
+                  lambda a, b: a + b, dense_keys=10)
+    assert r.frame_combiner.dense_keys == 10
+    with pytest.raises(Exception) as ei:
+        res = mesh_sess(mesh).run(r)
+        list(res.rows())
+    assert "dense_keys" in repr(ei.value) or "partitioner" in repr(
+        ei.value)
+
+
+def test_dense_result_feeds_downstream_consumers(mesh):
+    """Partition routing must match the hash contract: a consumer
+    compiled against the dense producer reads aligned partitions."""
+    rng = np.random.RandomState(6)
+    K = 128
+    keys = rng.randint(0, K, 2000).astype(np.int32)
+    sess = mesh_sess(mesh)
+    red = bs.Reduce(bs.Const(8, keys, np.ones(2000, np.int32)),
+                    lambda a, b: a + b, dense_keys=K)
+    m = bs.Map(red, lambda k, c: (k, c * 10))
+    res = sess.run(m)
+    want = {k: int(c) * 10 for k, c in
+            zip(*np.unique(keys, return_counts=True))}
+    assert dict(res.rows()) == want
+
+
+def test_wordcount_model_uses_dense_path(tmp_path):
+    from bigslice_tpu.exec.local import LocalExecutor
+    import bigslice_tpu.models.urls as urls_mod
+
+    p = tmp_path / "urls.txt"
+    lines = [f"http://site{i % 5}.com/p{i}" for i in range(100)]
+    p.write_text("\n".join(lines) + "\n")
+    sess = Session(executor=LocalExecutor())
+    rows = urls_mod.domain_count_encoded(sess, 2, str(p))
+    assert dict(rows) == {f"site{i}.com": 20 for i in range(5)}
+
+
+def test_dense_combine_single_partition_one_device_mesh():
+    """1-chip shape (the real-TPU bench case): no shuffle stage at all;
+    the map-side combine stage itself takes the dense-table path."""
+    from jax.sharding import Mesh
+
+    mesh1 = Mesh(np.array(jax.devices()[:1]), ("shards",))
+    rng = np.random.RandomState(7)
+    K = 200
+    keys = rng.randint(0, K, 5000).astype(np.int32)
+    vals = rng.randint(-5, 5, 5000).astype(np.int32)
+    sess = mesh_sess(mesh1)
+    res = sess.run(bs.Reduce(bs.Const(1, keys, vals),
+                             lambda a, b: a + b, dense_keys=K))
+    assert dict(res.rows()) == oracle(keys, vals, "add")
+    assert sess.executor.device_group_count() >= 1
+
+
+def test_dense_combine_out_of_range_single_partition_raises():
+    from jax.sharding import Mesh
+
+    mesh1 = Mesh(np.array(jax.devices()[:1]), ("shards",))
+    keys = np.array([0, 1, 50], dtype=np.int32)
+    sess = mesh_sess(mesh1)
+    r = bs.Reduce(bs.Const(1, keys, np.ones(3, np.int32)),
+                  lambda a, b: a + b, dense_keys=10)
+    assert r.frame_combiner.dense_keys == 10
+    with pytest.raises(Exception) as ei:
+        res = sess.run(r)
+        list(res.rows())
+    assert "dense_keys" in repr(ei.value) or "partitioner" in repr(
+        ei.value)

@@ -119,6 +119,10 @@ def main() -> None:
         run(f"reduce_e2e_{n}",
             lambda: (bench.reduce_e2e_bench(keys, vals),
                      bench.cpu_reduce_baseline(keys, vals)))
+        run(f"reduce_dense_{n}",
+            lambda: (bench.reduce_e2e_bench(keys, vals,
+                                            dense_keys=1 << 16),
+                     bench.cpu_reduce_baseline(keys, vals)))
 
     for n in [1 << 19, 1 << 21] + ([1 << 23] if full else []):
         run(f"join_e2e_{n}",
