@@ -1049,7 +1049,13 @@ class MeshExecutor:
                 fa, fb = s.frame_combiners
                 stages.append((
                     "join",
-                    (id(fa.fn), id(fb.fn), s.prefix, fa.nvals, fb.nvals),
+                    (id(fa.fn), id(fb.fn), s.prefix, fa.nvals, fb.nvals,
+                     getattr(fa, "dense_keys", None),
+                     getattr(fb, "dense_keys", None),
+                     # join_prelude's dense gate branches on the
+                     # input routing width (= consumer shard count);
+                     # it must key the compiled program.
+                     s.num_shards),
                     s,
                 ))
         if task.num_partition > 1:
@@ -1125,12 +1131,37 @@ class MeshExecutor:
             reduction (per-device = global per key, since the producer
             shuffles routed equal keys here), then align with the shared
             tagged-sort kernel (parallel/join.make_align) — matched
-            (A,B) adjacent pairs become output rows."""
+            (A,B) adjacent pairs become output rows. Dense-declared
+            joins skip both the reduces and the sort: rank-indexed
+            scatter tables + an elementwise presence AND
+            (parallel/dense.make_dense_join). Returns
+            (mask, cols, bad)."""
             from bigslice_tpu.parallel.join import make_align
 
             fcA, fcB = s.frame_combiners
             nk = s.prefix
             colsA, colsB = col_sets
+            dkA = getattr(fcA, "dense_keys", None)
+            dkB = getattr(fcB, "dense_keys", None)
+            # Dense join requires this device's wave-0 partition to BE
+            # its mesh position (waved groups shift partition indices),
+            # and a table in the same league as the inputs (see the
+            # combine-stage heuristic).
+            if (dkA is not None and dkA == dkB
+                    and s.num_shards == nmesh
+                    and dkA <= 4 * (colsA[0].shape[0]
+                                    + colsB[0].shape[0])):
+                from bigslice_tpu.parallel import dense as dense_mod
+
+                djoin, _ = dense_mod.make_dense_join(
+                    dkA, fcA.dense_ops, fcB.dense_ops,
+                    [ct.dtype for ct in s.a.schema.values],
+                    [ct.dtype for ct in s.b.schema.values],
+                    nmesh, axis,
+                )
+                mask, cols, bad = djoin(masks[0], colsA, masks[1],
+                                        colsB)
+                return mask, cols, bad
             coreA = segment.make_segmented_reduce_masked(
                 nk, fcA.nvals, segment.canonical_combine(fcA.fn, fcA.nvals)
             )
@@ -1141,8 +1172,10 @@ class MeshExecutor:
                                   tuple(colsA[nk:]))
             keepB, kB, vB = coreB(masks[1], tuple(colsB[:nk]),
                                   tuple(colsB[nk:]))
-            align = make_align(nk, fcA.nvals, fcB.nvals)
-            return align(keepA, kA, vA, keepB, kB, vB)
+            mask, cols = make_align(nk, fcA.nvals, fcB.nvals)(
+                keepA, kA, vA, keepB, kB, vB
+            )
+            return mask, cols, jnp.int32(0)
 
         def stepped(wave, *counts_cols_extras):
             # Mask-chained stages: validity rides as a bool mask between
@@ -1172,7 +1205,9 @@ class MeshExecutor:
             badrange = jnp.int32(0)
             run_stages = stages
             if stages and stages[0][0] == "join":
-                mask, cols = join_prelude(stages[0][2], masks, col_sets)
+                mask, cols, jbad = join_prelude(stages[0][2], masks,
+                                                col_sets)
+                badrange = badrange + jbad
                 run_stages = stages[1:]
             else:
                 cols = col_sets[0]

@@ -48,7 +48,8 @@ class JoinAggregate(Slice):
     form for single-value sides).
     """
 
-    def __init__(self, a: Slice, b: Slice, a_fn: Callable, b_fn: Callable):
+    def __init__(self, a: Slice, b: Slice, a_fn: Callable,
+                 b_fn: Callable, dense_keys=None):
         for s, side in ((a, "left"), (b, "right")):
             typecheck.check(
                 s.prefix >= 1,
@@ -84,9 +85,14 @@ class JoinAggregate(Slice):
         # Per-dep map-side combiners: the compiler attaches
         # frame_combiners[i] to dep i's producer tasks (exec/compile.py
         # _frame_combiner), so each side pre-reduces before its shuffle.
+        # ``dense_keys``: both sides' (single int32) keys are dense
+        # codes in [0, dense_keys) — each side's map-side combine +
+        # shuffle AND the join's alignment take the sort-free dense
+        # lowering (parallel/dense.py) when the combine fns classify as
+        # add/max/min; otherwise the declaration is ignored.
         self.frame_combiners = (
-            FrameCombiner(a_fn, a.schema),
-            FrameCombiner(b_fn, b.schema),
+            FrameCombiner(a_fn, a.schema, dense_keys=dense_keys),
+            FrameCombiner(b_fn, b.schema, dense_keys=dense_keys),
         )
 
     def deps(self):

@@ -127,6 +127,24 @@ def _identity(op: str, dtype) -> np.generic:
     raise ValueError(op)
 
 
+def _scatter_tables(idx, vals, ops, idents, size: int):
+    """The shared table pass: identity-initialized [size] tables, one
+    scatter-accumulate per value column (idx == size-1 may serve as the
+    caller's drop lane). Returns (present bool[size], tables)."""
+    import jax.numpy as jnp
+
+    present = jnp.zeros((size,), bool).at[idx].set(True)
+    tables = []
+    for v, op, ident in zip(vals, ops, idents):
+        t = jnp.full((size,), ident, v.dtype)
+        upd = t.at[idx]
+        t = (upd.add(v) if op == "add"
+             else upd.max(v) if op == "max"
+             else upd.min(v))
+        tables.append(t)
+    return present, tables
+
+
 @functools.lru_cache(maxsize=32)
 def routing_tables(K: int, nparts: int, seed: int) -> Tuple[np.ndarray, int]:
     """Static slot routing: ``slot_table[p]`` lists the keys owned by
@@ -173,19 +191,79 @@ def make_dense_combine(K: int, ops: Tuple[str, ...],
         # channel for it) so declared-range violations still fail the
         # run loudly instead of dropping rows.
         idx = jnp.where(valid & in_range, key, np.int32(K))
-        present = jnp.zeros((K + 1,), bool).at[idx].set(True)
-        out_vals = []
-        for v, op, ident in zip(vals, ops, idents):
-            t = jnp.full((K + 1,), ident, v.dtype)
-            upd = t.at[idx]
-            t = (upd.add(v) if op == "add"
-                 else upd.max(v) if op == "max"
-                 else upd.min(v))
-            out_vals.append(t[:K])
+        present, tables = _scatter_tables(idx, vals, ops, idents, K + 1)
         out_key = jnp.arange(K, dtype=np.int32)
-        return present[:K], (out_key,), tuple(out_vals)
+        return present[:K], (out_key,), tuple(t[:K] for t in tables)
 
     return masked
+
+
+@functools.lru_cache(maxsize=32)
+def rank_tables(K: int, nparts: int, seed: int):
+    """Static inverse routing: for key k, ``pid[k]`` is its owning
+    partition and ``rank[k]`` its slot position within that partition's
+    ``slot_table`` row. One [K] table each, shared by every device.
+    Returns (pid int32[K], rank int32[K], maxc)."""
+    slot_table, maxc = routing_tables(K, nparts, seed)
+    pid = np.empty(K, dtype=np.int32)
+    rank = np.empty(K, dtype=np.int32)
+    for p in range(nparts):
+        slots = slot_table[p]
+        valid = slots != K
+        pid[slots[valid]] = p
+        rank[slots[valid]] = np.flatnonzero(valid).astype(np.int32)
+    return pid, rank, maxc
+
+
+def make_dense_join(K: int, ops_a: Tuple[str, ...],
+                    ops_b: Tuple[str, ...], dtypes_a: Sequence,
+                    dtypes_b: Sequence, nparts: int, axis: str,
+                    seed: int = 0):
+    """Sort-free aggregating inner join for dense-coded keys: each side
+    scatter-accumulates into a [maxc] local table indexed by the static
+    within-partition rank of its keys (this device holds exactly its
+    partition's keys, by the shared routing contract), then the match
+    is an elementwise AND of the presence planes — no segmented
+    reduces, no alignment sort.
+
+    Returns ``fn(mask_a, cols_a, mask_b, cols_b) -> (mask, cols, bad)``
+    with cols = (key, *vals_a, *vals_b), each [maxc]; ``bad`` counts
+    rows whose key is outside [0, K) or not owned by this device
+    (either violates the declared contract)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    slot_table_np, maxc = routing_tables(K, nparts, seed)
+    pid_np, rank_np, _ = rank_tables(K, nparts, seed)
+    idents_a = [_identity(op, dt) for op, dt in zip(ops_a, dtypes_a)]
+    idents_b = [_identity(op, dt) for op, dt in zip(ops_b, dtypes_b)]
+
+    def side(mask, key, vals, ops, idents, pid, rank, me):
+        in_range = (key >= 0) & (key < K)
+        safe_key = jnp.where(in_range, key, 0)
+        owned = in_range & (pid[safe_key] == me)
+        bad = lax.psum(
+            jnp.sum((mask & ~owned).astype(np.int32)), axis
+        )
+        idx = jnp.where(mask & owned, rank[safe_key], np.int32(maxc))
+        present, tables = _scatter_tables(idx, vals, ops, idents,
+                                          maxc + 1)
+        return present[:maxc], [t[:maxc] for t in tables], bad
+
+    def join(mask_a, cols_a, mask_b, cols_b):
+        slot_table = jnp.asarray(slot_table_np)
+        pid = jnp.asarray(pid_np)
+        rank = jnp.asarray(rank_np)
+        me = lax.axis_index(axis)
+        pa, ta, bad_a = side(mask_a, cols_a[0], cols_a[1:], ops_a,
+                             idents_a, pid, rank, me)
+        pb, tb, bad_b = side(mask_b, cols_b[0], cols_b[1:], ops_b,
+                             idents_b, pid, rank, me)
+        my_slots = slot_table[me]
+        mask = pa & pb & (my_slots != K)
+        return mask, [my_slots, *ta, *tb], bad_a + bad_b
+
+    return join, maxc
 
 
 def make_dense_combine_shuffle(nmesh: int, K: int, ops: Tuple[str, ...],
@@ -216,17 +294,7 @@ def make_dense_combine_shuffle(nmesh: int, K: int, ops: Tuple[str, ...],
 
         # 1. Per-shard dense tables: one scatter-accumulate pass (the
         # K-th row is the drop lane for invalid/out-of-range rows).
-        present = jnp.zeros((K + 1,), bool).at[idx].set(
-            True, mode="drop"
-        )
-        tables = []
-        for v, op, ident in zip(vals, ops, idents):
-            t = jnp.full((K + 1,), ident, v.dtype)
-            upd = t.at[idx]
-            t = (upd.add(v, mode="drop") if op == "add"
-                 else upd.max(v, mode="drop") if op == "max"
-                 else upd.min(v, mode="drop"))
-            tables.append(t)
+        present, tables = _scatter_tables(idx, vals, ops, idents, K + 1)
 
         # 2. Gather through the static routing permutation, then ONE
         # all_to_all: device p receives every shard's partition-p

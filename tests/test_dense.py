@@ -231,3 +231,92 @@ def test_dense_combine_out_of_range_single_partition_raises():
         list(res.rows())
     assert "dense_keys" in repr(ei.value) or "partitioner" in repr(
         ei.value)
+
+
+# -------------------------------------------------------------- dense join
+
+def join_oracle(ak, av, bk, bv):
+    A, B = {}, {}
+    for k, v in zip(ak.tolist(), av.tolist()):
+        A[k] = A.get(k, 0) + v
+    for k, v in zip(bk.tolist(), bv.tolist()):
+        B[k] = B.get(k, 0) + v
+    return {k: (A[k], B[k]) for k in A if k in B}
+
+
+def test_dense_join_matches_oracle(mesh):
+    rng = np.random.RandomState(8)
+    K = 400
+    ak = rng.randint(0, K, 4000).astype(np.int32)
+    bk = rng.randint(0, K // 2, 4000).astype(np.int32)  # partial overlap
+    av = rng.randint(1, 5, 4000).astype(np.int32)
+    bv = rng.randint(1, 5, 4000).astype(np.int32)
+    j = bs.JoinAggregate(
+        bs.Const(8, ak, av), bs.Const(8, bk, bv),
+        lambda a, b: a + b, lambda a, b: a + b, dense_keys=K,
+    )
+    assert j.frame_combiners[0].dense_keys == K
+    res = mesh_sess(mesh).run(j)
+    got = {k: (x, y) for k, x, y in res.rows()}
+    assert got == join_oracle(ak, av, bk, bv)
+
+
+def test_dense_join_matches_sort_join(mesh):
+    rng = np.random.RandomState(9)
+    K = 256
+    ak = rng.randint(0, K, 3000).astype(np.int32)
+    bk = rng.randint(0, K, 3000).astype(np.int32)
+    av = np.ones(3000, np.int32)
+    bv = np.ones(3000, np.int32)
+
+    def add(a, b):
+        return a + b
+
+    jd = mesh_sess(mesh).run(bs.JoinAggregate(
+        bs.Const(8, ak, av), bs.Const(8, bk, bv), add, add,
+        dense_keys=K))
+    js = mesh_sess(mesh).run(bs.JoinAggregate(
+        bs.Const(8, ak, av), bs.Const(8, bk, bv), add, add))
+    assert sorted(jd.rows()) == sorted(js.rows())
+
+
+def test_dense_join_single_device():
+    from jax.sharding import Mesh
+
+    mesh1 = Mesh(np.array(jax.devices()[:1]), ("shards",))
+    rng = np.random.RandomState(10)
+    K = 100
+    ak = rng.randint(0, K, 1000).astype(np.int32)
+    bk = rng.randint(0, K, 1000).astype(np.int32)
+    av = rng.randint(1, 3, 1000).astype(np.int32)
+    bv = rng.randint(1, 3, 1000).astype(np.int32)
+    res = mesh_sess(mesh1).run(bs.JoinAggregate(
+        bs.Const(1, ak, av), bs.Const(1, bk, bv),
+        lambda a, b: a + b, lambda a, b: a + b, dense_keys=K))
+    got = {k: (x, y) for k, x, y in res.rows()}
+    assert got == join_oracle(ak, av, bk, bv)
+
+
+def test_dense_join_then_narrower_shard_count_no_cache_collision(mesh):
+    """Same fn objects + dense_keys at two shard widths: the program
+    cache must not reuse the 8-wide dense-join lowering for the 4-shard
+    run (its routing/ownership checks would spuriously flag bad keys)."""
+    rng = np.random.RandomState(11)
+    K = 64
+    ak = rng.randint(0, K, 512).astype(np.int32)
+    bk = rng.randint(0, K, 512).astype(np.int32)
+    ones = np.ones(512, np.int32)
+
+    def add(a, b):
+        return a + b
+
+    sess = mesh_sess(mesh)
+    r8 = sess.run(bs.JoinAggregate(
+        bs.Const(8, ak, ones), bs.Const(8, bk, ones), add, add,
+        dense_keys=K))
+    want = join_oracle(ak, ones, bk, ones)
+    assert {k: (x, y) for k, x, y in r8.rows()} == want
+    r4 = sess.run(bs.JoinAggregate(
+        bs.Const(4, ak, ones), bs.Const(4, bk, ones), add, add,
+        dense_keys=K))
+    assert {k: (x, y) for k, x, y in r4.rows()} == want

@@ -128,6 +128,9 @@ def main() -> None:
         run(f"join_e2e_{n}",
             lambda: (bench.join_e2e_bench(n),
                      bench.cpu_join_baseline(*bench.join_inputs(n))))
+        run(f"join_dense_{n}",
+            lambda: (bench.join_e2e_bench(n, dense=True),
+                     bench.cpu_join_baseline(*bench.join_inputs(n))))
 
     run(f"wordcount_{1 << 20}", lambda: bench.wordcount_bench(1 << 20))
     run(f"sortshuffle_{1 << 22}",
