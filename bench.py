@@ -181,11 +181,15 @@ def reduce_e2e_bench(keys, vals, iters: int = 3, dense_keys=None):
 
 # ------------------------------------------------------------------ join
 
+def join_key_space(n_rows: int) -> int:
+    return max(16, n_rows // 16)
+
+
 def join_inputs(n_rows: int):
     """The join benches' synthetic two-sided keyed input — ONE
     derivation shared by the bench bodies, main(), and tools_bench_all
     so the measured workload and its CPU baseline can't drift apart."""
-    nk = max(16, n_rows // 16)
+    nk = join_key_space(n_rows)
     r1, r2 = np.random.RandomState(1), np.random.RandomState(2)
     return (r1.randint(0, nk, n_rows).astype(np.int32),
             r2.randint(0, nk, n_rows).astype(np.int32))
@@ -251,7 +255,7 @@ def join_e2e_bench(n_rows: int, iters: int = 3, dense: bool = False):
     n = mesh.devices.size
     ak, bk = join_inputs(n_rows)
     ones = np.ones(n_rows, np.int32)
-    dense_k = max(16, n_rows // 16) if dense else None
+    dense_k = join_key_space(n_rows) if dense else None
 
     def add(a, b):
         return a + b

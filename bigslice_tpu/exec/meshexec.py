@@ -1149,8 +1149,11 @@ class MeshExecutor:
             # combine-stage heuristic).
             if (dkA is not None and dkA == dkB
                     and s.num_shards == nmesh
-                    and dkA <= 4 * (colsA[0].shape[0]
-                                    + colsB[0].shape[0])):
+                    # Table cost is maxc ≈ dk/nmesh per device — that,
+                    # not the global key count, is what must stay in
+                    # the inputs' league.
+                    and dkA <= 4 * nmesh * (colsA[0].shape[0]
+                                            + colsB[0].shape[0])):
                 from bigslice_tpu.parallel import dense as dense_mod
 
                 djoin, _ = dense_mod.make_dense_join(

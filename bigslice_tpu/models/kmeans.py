@@ -96,7 +96,10 @@ def kmeans(sess, points: np.ndarray, k: int, iters: int = 10,
         # iteration reuses the same compiled assignment and reduce
         # kernels instead of recompiling per round.
         assigned = bs.Map(base, _assign_vec, args=(centroids,))
-        summed = bs.Reduce(assigned, _sum_combine)
+        # Centroid ids are dense in [0, k) by construction: the
+        # per-centroid vector sums take the sort-free scatter-table
+        # lowering ([k, d] tables instead of sorting n [d]-vectors).
+        summed = bs.Reduce(assigned, _sum_combine, dense_keys=k)
         rows = sess.run(summed).rows()
         for cid, vec, cnt in rows:
             if cnt > 0:

@@ -87,12 +87,13 @@ class FrameCombiner:
             # Oversized/invalid bounds quietly keep the sort path
             # (callers derive the bound from data size — e.g.
             # dictenc's len(vocab) — and must not start crashing when
-            # the data grows past the table cap).
-            if (0 < dense_keys <= dense.MAX_DENSE_KEYS
-                    and all(ct.shape == () for ct in schema.values)):
+            # the data grows past the table cap). Vector VALUE columns
+            # are fine (rows scatter whole); the KEY must be scalar.
+            if 0 < dense_keys <= dense.MAX_DENSE_KEYS:
                 ops = dense.classified_ops_cached(
                     fn, self.nvals,
                     tuple(np.dtype(ct.dtype) for ct in schema.values),
+                    tuple(tuple(ct.shape) for ct in schema.values),
                 )
             if ops is not None:
                 self.dense_keys = int(dense_keys)

@@ -45,10 +45,13 @@ import numpy as np
 MAX_DENSE_KEYS = 1 << 22
 
 
-def classify_combine_ops(cfn, val_dtypes: Sequence) -> Optional[Tuple[str, ...]]:
+def classify_combine_ops(cfn, val_dtypes: Sequence,
+                         val_shapes: Optional[Sequence] = None
+                         ) -> Optional[Tuple[str, ...]]:
     """Classify a canonical combine fn as per-column ('add'|'max'|'min')
-    by probing it on random vectors of the actual value dtypes; None
-    when any column doesn't match (the sort path handles it).
+    by probing it on random vectors of the actual value dtypes (and
+    trailing shapes — vector value columns classify too); None when any
+    column doesn't match (the sort path handles it).
 
     A user fn that equals one of the candidates on 64 random pairs per
     column but diverges elsewhere is implausible; cross-column fns
@@ -59,18 +62,21 @@ def classify_combine_ops(cfn, val_dtypes: Sequence) -> Optional[Tuple[str, ...]]
 
     rng = np.random.RandomState(0)
     n = 64
+    if val_shapes is None:
+        val_shapes = [() for _ in val_dtypes]
 
-    def sample(dt):
+    def sample(dt, shape):
         dt = np.dtype(dt)
+        full = (n,) + tuple(shape)
         if dt.kind == "f":
-            return (rng.randn(n) * 8).astype(dt)
+            return (rng.randn(*full) * 8).astype(dt)
         if dt.kind in "iu":
             lo, hi = (-(1 << 15), 1 << 15) if dt.kind == "i" else (0, 1 << 16)
-            return rng.randint(lo, hi, n).astype(dt)
+            return rng.randint(lo, hi, full).astype(dt)
         return None
 
-    a = [sample(dt) for dt in val_dtypes]
-    b = [sample(dt) for dt in val_dtypes]
+    a = [sample(dt, sh) for dt, sh in zip(val_dtypes, val_shapes)]
+    b = [sample(dt, sh) for dt, sh in zip(val_dtypes, val_shapes)]
     if any(x is None for x in a):
         return None
     try:
@@ -102,17 +108,19 @@ def classify_combine_ops(cfn, val_dtypes: Sequence) -> Optional[Tuple[str, ...]]
 
 
 @functools.lru_cache(maxsize=256)
-def classified_ops_cached(fn, nvals: int,
-                          val_dtypes: tuple) -> Optional[Tuple[str, ...]]:
+def classified_ops_cached(fn, nvals: int, val_dtypes: tuple,
+                          val_shapes: tuple = None
+                          ) -> Optional[Tuple[str, ...]]:
     """Memoized classify_combine_ops keyed on the fn object + value
-    dtypes: iterative drivers rebuild Reduce slices every round (the
-    id(fn)-keyed program caches depend on exactly that), and the vmap
-    probe must not recur per step. The cache pins fn, like the program
-    caches do."""
+    dtypes/shapes: iterative drivers rebuild Reduce slices every round
+    (the id(fn)-keyed program caches depend on exactly that), and the
+    vmap probe must not recur per step. The cache pins fn, like the
+    program caches do."""
     from bigslice_tpu.parallel import segment
 
     return classify_combine_ops(
-        segment.canonical_combine(fn, nvals), list(val_dtypes)
+        segment.canonical_combine(fn, nvals), list(val_dtypes),
+        list(val_shapes) if val_shapes is not None else None,
     )
 
 
@@ -128,15 +136,16 @@ def _identity(op: str, dtype) -> np.generic:
 
 
 def _scatter_tables(idx, vals, ops, idents, size: int):
-    """The shared table pass: identity-initialized [size] tables, one
-    scatter-accumulate per value column (idx == size-1 may serve as the
-    caller's drop lane). Returns (present bool[size], tables)."""
+    """The shared table pass: identity-initialized [size(, ...trailing)]
+    tables, one scatter-accumulate per value column — vector value
+    columns scatter whole rows (idx == size-1 may serve as the caller's
+    drop lane). Returns (present bool[size], tables)."""
     import jax.numpy as jnp
 
     present = jnp.zeros((size,), bool).at[idx].set(True)
     tables = []
     for v, op, ident in zip(vals, ops, idents):
-        t = jnp.full((size,), ident, v.dtype)
+        t = jnp.full((size,) + tuple(v.shape[1:]), ident, v.dtype)
         upd = t.at[idx]
         t = (upd.add(v) if op == "add"
              else upd.max(v) if op == "max"
@@ -242,9 +251,7 @@ def make_dense_join(K: int, ops_a: Tuple[str, ...],
         in_range = (key >= 0) & (key < K)
         safe_key = jnp.where(in_range, key, 0)
         owned = in_range & (pid[safe_key] == me)
-        bad = lax.psum(
-            jnp.sum((mask & ~owned).astype(np.int32)), axis
-        )
+        bad = jnp.sum((mask & ~owned).astype(np.int32))  # local
         idx = jnp.where(mask & owned, rank[safe_key], np.int32(maxc))
         present, tables = _scatter_tables(idx, vals, ops, idents,
                                           maxc + 1)
@@ -261,7 +268,9 @@ def make_dense_join(K: int, ops_a: Tuple[str, ...],
                              idents_b, pid, rank, me)
         my_slots = slot_table[me]
         mask = pa & pb & (my_slots != K)
-        return mask, [my_slots, *ta, *tb], bad_a + bad_b
+        # One collective for both sides' bad counts.
+        bad = lax.psum(bad_a + bad_b, axis)
+        return mask, [my_slots, *ta, *tb], bad
 
     return join, maxc
 

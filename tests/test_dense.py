@@ -320,3 +320,26 @@ def test_dense_join_then_narrower_shard_count_no_cache_collision(mesh):
         bs.Const(4, ak, ones), bs.Const(4, bk, ones), add, add,
         dense_keys=K))
     assert {k: (x, y) for k, x, y in r4.rows()} == want
+
+
+def test_dense_vector_value_columns(mesh):
+    """Vector value columns scatter whole rows (the kmeans shape:
+    Reduce of (cid, [d] vec, weight) with dense centroid ids)."""
+    rng = np.random.RandomState(12)
+    K, d = 16, 8
+    keys = rng.randint(0, K, 2000).astype(np.int32)
+    vecs = rng.randn(2000, d).astype(np.float32)
+    w = np.ones(2000, np.float32)
+
+    def fn(a, b):
+        return (a[0] + b[0], a[1] + b[1])
+
+    r = bs.Reduce(bs.Const(8, keys, vecs, w), fn, dense_keys=K)
+    assert r.frame_combiner.dense_keys == K
+    res = mesh_sess(mesh).run(r)
+    got = {int(k): (np.asarray(v), float(c)) for k, v, c in res.rows()}
+    for k in range(K):
+        sel = keys == k
+        assert got[k][1] == sel.sum()
+        np.testing.assert_allclose(got[k][0], vecs[sel].sum(0),
+                                   rtol=1e-4, atol=1e-4)
