@@ -135,3 +135,46 @@ def test_partition_conservation(n, nparts, nkeys):
     if n:
         assert ids.min() >= 0 and ids.max() < nparts
     np.testing.assert_array_equal(ids, f.partition_ids(nparts))
+
+
+# -- dense lowering vs oracle ------------------------------------------
+
+@given(
+    n=st.integers(min_value=1, max_value=600),
+    K=st.integers(min_value=1, max_value=300),
+    nshards=st.sampled_from([1, 3, 8]),
+    op=st.sampled_from(["add", "max", "min"]),
+    seed=st.integers(min_value=0, max_value=2**16),
+)
+@settings(**_SETTINGS)
+def test_dense_reduce_matches_oracle_quickcheck(n, K, nshards, op, seed):
+    """testing/quick-style oracle check (example/max_test.go:49-60
+    shape) for the sort-free dense lowering across random sizes, key
+    spaces, shardings, and ops."""
+    import jax.numpy as jnp
+
+    from bigslice_tpu.exec.meshexec import MeshExecutor
+    from bigslice_tpu.exec.session import Session
+
+    rng = np.random.RandomState(seed)
+    keys = rng.randint(0, K, n).astype(np.int32)
+    vals = rng.randint(-1000, 1000, n).astype(np.int32)
+    fn = {
+        "add": lambda a, b: a + b,
+        "max": lambda a, b: jnp.maximum(a, b),
+        "min": lambda a, b: jnp.minimum(a, b),
+    }[op]
+    red = {"add": lambda s: int(s.sum()),
+           "max": lambda s: int(s.max()),
+           "min": lambda s: int(s.min())}[op]
+    want = {int(k): red(vals[keys == k])
+            for k in np.unique(keys)}
+
+    import jax
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(jax.devices()[:nshards]), ("shards",))
+    sess = Session(executor=MeshExecutor(mesh))
+    r = bs.Reduce(bs.Const(nshards, keys, vals), fn, dense_keys=K)
+    assert r.frame_combiner.dense_keys == K
+    assert dict(sess.run(r).rows()) == want
