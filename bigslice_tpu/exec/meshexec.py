@@ -1040,7 +1040,8 @@ class MeshExecutor:
                 stages.append((
                     "fold",
                     (id(s.fn), s.prefix, repr(s.init),
-                     str(s.acc_dtype)),
+                     str(s.acc_dtype),
+                     getattr(s, "dense_keys", None)),
                     s,
                 ))
             elif isinstance(s, GroupByKey):
@@ -1180,6 +1181,30 @@ class MeshExecutor:
             )
             return mask, cols, jnp.int32(0)
 
+        def dense_gate(dk, key_col, mask, badrange):
+            """Declared-dense bookkeeping shared by the combine and
+            fold stages: range violations count into the bad signal
+            WHENEVER a bound is declared (the loud-failure contract
+            must not depend on which lowering runs), while the dense
+            lowering itself only engages when the table stays in the
+            input's league (a K-row table and its K-row compaction
+            must not dwarf an input the sort kernels handle in
+            O(n log n) — e.g. a post-shuffle combine sees ~K/nmesh
+            rows). Static decision: shapes are compile-time. Returns
+            (dk_to_use_or_None, badrange)."""
+            if dk is None:
+                return None, badrange
+            from jax import lax as _lax
+
+            badrange = badrange + _lax.psum(
+                jnp.sum((mask & ((key_col < 0) | (key_col >= dk))
+                         ).astype(np.int32)),
+                axis,
+            )
+            if dk > 2 * key_col.shape[0]:
+                return None, badrange
+            return dk, badrange
+
         def stepped(wave, *counts_cols_extras):
             # Mask-chained stages: validity rides as a bool mask between
             # stages (no per-stage compaction sorts — filters and
@@ -1248,36 +1273,19 @@ class MeshExecutor:
                     mask = mask & (rank <= s.n)
                 elif kind == "combine":
                     fc = s.frame_combiner
-                    dk = getattr(fc, "dense_keys", None)
-                    # Dense only while the table is in the same league
-                    # as the input: a K-row table (and the K-row
-                    # compaction after it) must not dwarf an input the
-                    # segmented reduce would handle in O(n log n) —
-                    # e.g. the post-shuffle combine of a dense producer
-                    # sees ~K/nmesh rows; a full-K table per device
-                    # would re-inflate the pipeline. Static decision:
-                    # shapes are compile-time.
-                    if dk is not None and dk > 2 * cols[0].shape[0]:
-                        dk = None
-                    if dk is not None:
+                    use_dk, badrange = dense_gate(
+                        getattr(fc, "dense_keys", None), cols[0],
+                        mask, badrange,
+                    )
+                    if use_dk is not None:
                         # Dense-coded keys: scatter-accumulate table
-                        # instead of sort+segmented-scan. Out-of-range
-                        # keys count into the bad signal (checked
-                        # whether or not a shuffle follows).
+                        # instead of sort+segmented-scan.
                         from bigslice_tpu.parallel import (
                             dense as dense_mod,
                         )
-                        from jax import lax as _lax
 
-                        key_col = cols[0]
-                        badrange = badrange + _lax.psum(
-                            jnp.sum((mask & ((key_col < 0)
-                                             | (key_col >= dk))
-                                     ).astype(np.int32)),
-                            axis,
-                        )
                         core = dense_mod.make_dense_combine(
-                            dk, fc.dense_ops,
+                            use_dk, fc.dense_ops,
                             [ct.dtype for ct in s.schema.values],
                         )
                     else:
@@ -1292,9 +1300,23 @@ class MeshExecutor:
                     cols = list(keys) + list(vals)
                 elif kind == "fold":
                     nk = s.prefix
-                    core = segment.make_sequential_fold_masked(
-                        nk, len(cols) - nk, s.fn, s.init, s.acc_dtype
+                    use_dk, badrange = dense_gate(
+                        getattr(s, "dense_keys", None), cols[0],
+                        mask, badrange,
                     )
+                    if use_dk is not None:
+                        from bigslice_tpu.parallel import (
+                            dense as dense_mod,
+                        )
+
+                        core = dense_mod.make_dense_fold(
+                            use_dk, s.dense_op, s.acc_dtype, s.init
+                        )
+                    else:
+                        core = segment.make_sequential_fold_masked(
+                            nk, len(cols) - nk, s.fn, s.init,
+                            s.acc_dtype
+                        )
                     mask, keys, accs = core(
                         mask, tuple(cols[:nk]), tuple(cols[nk:])
                     )

@@ -36,7 +36,7 @@ class Fold(Slice):
     """
 
     def __init__(self, slice_: Slice, fn: Callable, init: Any = 0,
-                 out_value=None):
+                 out_value=None, dense_keys=None):
         typecheck.check(
             slice_.prefix >= 1, "fold: input slice must have a key prefix"
         )
@@ -66,6 +66,30 @@ class Fold(Slice):
         self.init = init
         self.acc_dtype = schema.cols[slice_.prefix].dtype
         self.device = self._device_eligible()
+        # ``dense_keys``: single int32 key holds dense codes in
+        # [0, dense_keys); classified associative fold fns take the
+        # sort-free scatter-table lowering (parallel/dense.py) —
+        # ignored otherwise (Reduce's dense_keys contract).
+        self.dense_keys = None
+        self.dense_op = None
+        if (dense_keys is not None and self.device
+                and slice_.prefix == 1
+                and len(slice_.schema) == 2
+                and np.dtype(slice_.schema.cols[0].dtype)
+                == np.dtype(np.int32)
+                and slice_.schema.cols[0].shape == ()
+                and slice_.schema.cols[1].shape == ()
+                and not callable(init)):
+            from bigslice_tpu.parallel import dense
+
+            if 0 < dense_keys <= dense.MAX_DENSE_KEYS:
+                op = dense.classified_fold_op_cached(
+                    fn, np.dtype(self.acc_dtype),
+                    np.dtype(slice_.schema.cols[1].dtype),
+                )
+                if op is not None:
+                    self.dense_keys = int(dense_keys)
+                    self.dense_op = op
 
     def _device_eligible(self) -> bool:
         """Traceable fold fn + scalar device schema + literal init →

@@ -61,22 +61,12 @@ def classify_combine_ops(cfn, val_dtypes: Sequence,
     import jax.numpy as jnp
 
     rng = np.random.RandomState(0)
-    n = 64
     if val_shapes is None:
         val_shapes = [() for _ in val_dtypes]
-
-    def sample(dt, shape):
-        dt = np.dtype(dt)
-        full = (n,) + tuple(shape)
-        if dt.kind == "f":
-            return (rng.randn(*full) * 8).astype(dt)
-        if dt.kind in "iu":
-            lo, hi = (-(1 << 15), 1 << 15) if dt.kind == "i" else (0, 1 << 16)
-            return rng.randint(lo, hi, full).astype(dt)
-        return None
-
-    a = [sample(dt, sh) for dt, sh in zip(val_dtypes, val_shapes)]
-    b = [sample(dt, sh) for dt, sh in zip(val_dtypes, val_shapes)]
+    a = [_probe_sample(rng, dt, sh) for dt, sh in
+         zip(val_dtypes, val_shapes)]
+    b = [_probe_sample(rng, dt, sh) for dt, sh in
+         zip(val_dtypes, val_shapes)]
     if any(x is None for x in a):
         return None
     try:
@@ -94,17 +84,38 @@ def classify_combine_ops(cfn, val_dtypes: Sequence,
         return None
     ops = []
     for x, y, o in zip(a, b, out):
-        if o.dtype != x.dtype or o.shape != x.shape:
+        op = _match_op(o, x, y)
+        if op is None:
             return None
-        if np.array_equal(o, x + y):
-            ops.append("add")
-        elif np.array_equal(o, np.maximum(x, y)):
-            ops.append("max")
-        elif np.array_equal(o, np.minimum(x, y)):
-            ops.append("min")
-        else:
-            return None
+        ops.append(op)
     return tuple(ops)
+
+
+_PROBE_N = 64
+
+
+def _probe_sample(rng, dt, shape=()):
+    dt = np.dtype(dt)
+    full = (_PROBE_N,) + tuple(shape)
+    if dt.kind == "f":
+        return (rng.randn(*full) * 8).astype(dt)
+    if dt.kind in "iu":
+        lo, hi = (-(1 << 15), 1 << 15) if dt.kind == "i" else (0, 1 << 16)
+        return rng.randint(lo, hi, full).astype(dt)
+    return None
+
+
+def _match_op(out, x, y):
+    """Which of add/max/min does ``out`` equal on this probe pair?"""
+    if out.dtype != x.dtype or out.shape != x.shape:
+        return None
+    if np.array_equal(out, x + y):
+        return "add"
+    if np.array_equal(out, np.maximum(x, y)):
+        return "max"
+    if np.array_equal(out, np.minimum(x, y)):
+        return "min"
+    return None
 
 
 @functools.lru_cache(maxsize=256)
@@ -273,6 +284,66 @@ def make_dense_join(K: int, ops_a: Tuple[str, ...],
         return mask, [my_slots, *ta, *tb], bad
 
     return join, maxc
+
+
+@functools.lru_cache(maxsize=256)
+def classified_fold_op_cached(fn, acc_dtype, val_dtype) -> Optional[str]:
+    """Classify a fold fn ``fn(acc, v) -> acc`` as 'add'|'max'|'min' by
+    the same vmap probe (None → the sequential-scan fold runs). A
+    classified fold op is associative+commutative, so scatter order is
+    immaterial."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    accd, vald = np.dtype(acc_dtype), np.dtype(val_dtype)
+    acc, v = _probe_sample(rng, accd), _probe_sample(rng, vald)
+    if acc is None or v is None:
+        return None
+    try:
+        out = np.asarray(jax.vmap(fn)(jnp.asarray(acc), jnp.asarray(v)))
+    except Exception:
+        return None
+    op = _match_op(out, acc, v.astype(accd))
+    # Fold's contract is SEQUENTIAL (non-associative fns allowed,
+    # slice.go:885), and the scan path honors it bit-for-bit. Float
+    # 'add' reassociates under scatter, so the dense lowering would
+    # diverge from the sequential result in low bits — keep float sums
+    # on the scan path. max/min are exactly associative for floats
+    # (NaN propagates identically in either order).
+    if op == "add" and accd.kind == "f":
+        return None
+    return op
+
+
+def make_dense_fold(K: int, op: str, acc_dtype, init_val):
+    """Sort-free dense Fold for classified (associative) fold fns:
+    scatter-accumulate into a [K] table, then apply the fold's init
+    through the op (``acc = op(init, fold(vals))`` — exactly the
+    sequential result for an associative, commutative op). Same
+    contract as make_sequential_fold_masked's core."""
+    import jax.numpy as jnp
+
+    accd = np.dtype(acc_dtype)
+    ident = _identity(op, accd)
+
+    def masked(valid, keys, vals):
+        (key,) = keys
+        (v,) = vals
+        in_range = (key >= 0) & (key < K)
+        idx = jnp.where(valid & in_range, key, np.int32(K))
+        present, (table,) = _scatter_tables(
+            idx, [v.astype(accd)], [op], [ident], K + 1
+        )
+        table = table[:K]
+        init = jnp.asarray(init_val, accd)
+        acc = (table + init if op == "add"
+               else jnp.maximum(table, init) if op == "max"
+               else jnp.minimum(table, init))
+        out_key = jnp.arange(K, dtype=np.int32)
+        return present[:K], (out_key,), (acc,)
+
+    return masked
 
 
 def make_dense_combine_shuffle(nmesh: int, K: int, ops: Tuple[str, ...],

@@ -343,3 +343,75 @@ def test_dense_vector_value_columns(mesh):
         assert got[k][1] == sel.sum()
         np.testing.assert_allclose(got[k][0], vecs[sel].sum(0),
                                    rtol=1e-4, atol=1e-4)
+
+
+# -------------------------------------------------------------- dense fold
+
+def test_dense_fold_max_matches_oracle(mesh):
+    """BASELINE config #1's named shape (Fold max over keyed ints,
+    example/max.go analog) on the dense lowering, init respected."""
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(13)
+    K = 100
+    keys = rng.randint(0, K, 3000).astype(np.int32)
+    vals = rng.randint(-1000, 1000, 3000).astype(np.int32)
+
+    def fmax(acc, v):
+        return jnp.maximum(acc, v)
+
+    f = bs.Fold(bs.Const(8, keys, vals), fmax, init=-50,
+                dense_keys=K)
+    assert f.dense_op == "max"
+    res = mesh_sess(mesh).run(f)
+    want = {int(k): max(int(vals[keys == k].max()), -50)
+            for k in np.unique(keys)}
+    assert dict(res.rows()) == want
+
+
+def test_dense_fold_add_with_wider_acc(mesh):
+    rng = np.random.RandomState(14)
+    K = 64
+    keys = rng.randint(0, K, 2000).astype(np.int32)
+    vals = rng.randint(0, 100, 2000).astype(np.int32)
+
+    def fadd(acc, v):
+        return acc + v
+
+    f = bs.Fold(bs.Const(8, keys, vals), fadd, init=7,
+                out_value=np.int32, dense_keys=K)
+    assert f.dense_op == "add"
+    res = mesh_sess(mesh).run(f)
+    want = {int(k): int(vals[keys == k].sum()) + 7
+            for k in np.unique(keys)}
+    assert dict(res.rows()) == want
+
+
+def test_nonassociative_fold_keeps_scan_path(mesh):
+    def weird(acc, v):
+        return acc * 2 + v  # order-dependent: must NOT classify
+
+    f = bs.Fold(bs.Const(4, np.zeros(10, np.int32),
+                         np.ones(10, np.int32)), weird, init=0,
+                dense_keys=5)
+    assert f.dense_keys is None
+
+
+def test_out_of_range_fails_even_when_heuristic_reverts(mesh):
+    """Declared-range enforcement must not depend on which lowering the
+    size heuristic picks: tiny input + big declared K reverts to the
+    sort/scan path, and the violation must still fail loudly."""
+    import jax.numpy as jnp
+
+    keys = np.array([0, 1, 5000], dtype=np.int32)  # 5000 >= K... no:
+    K = 4000  # K > 2 * input rows → heuristic keeps the scan path
+    sess = mesh_sess(mesh)
+    f = bs.Fold(bs.Const(1, keys, np.ones(3, np.int32)),
+                lambda acc, v: jnp.maximum(acc, v), init=0,
+                dense_keys=K)
+    assert f.dense_keys == K
+    with pytest.raises(Exception) as ei:
+        res = sess.run(f)
+        list(res.rows())
+    assert "dense_keys" in repr(ei.value) or "partitioner" in repr(
+        ei.value)
