@@ -141,6 +141,27 @@ def worker(num_processes: int, process_id: int, port: int,
     got_j = {k: (int(a), int(b)) for k, a, b in sess.run(join).rows()}
     assert got_j == join_count_oracle(ak.tolist(), bk.tolist())
 
+    # Dense lowerings under SPMD: the static-routed table all_to_all
+    # and the rank-indexed table join must agree with the sort path
+    # across real process boundaries too.
+    dred = bs.Reduce(
+        bs.Const(n, skeys, np.ones(len(skeys), np.int32)),
+        add, dense_keys=9,
+    )
+    assert dred.frame_combiner.dense_keys == 9
+    got_d = dict(sess.run(dred).rows())
+    expect_d: dict = {}
+    for kk in skeys.tolist():
+        expect_d[kk] = expect_d.get(kk, 0) + 1
+    assert got_d == expect_d, (got_d, expect_d)
+    djoin = bs.JoinAggregate(
+        bs.Const(n, ak, np.ones(len(ak), np.int32)),
+        bs.Const(n, bk, np.ones(len(bk), np.int32)),
+        add, add, dense_keys=18,
+    )
+    got_dj = {k: (int(a), int(b)) for k, a, b in sess.run(djoin).rows()}
+    assert got_dj == join_count_oracle(ak.tolist(), bk.tolist())
+
     # Iterative reuse across runs (Result as input) under SPMD.
     base = sess.run(bs.Const(n, np.arange(n * 8, dtype=np.int32)))
     doubled = sorted(sess.run(bs.Map(base, lambda x: x * 2)).rows())
