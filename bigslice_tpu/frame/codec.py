@@ -116,6 +116,55 @@ def decode_frame(data: bytes, offset: int = 0) -> tuple:
     return Frame(cols, schema), end
 
 
+ZMAGIC = b"BSZ1"  # zstd-compressed container of a BSF3 stream
+
+
+def open_compressed_write(fp):
+    """Wrap a binary file with a zstd stream writer (the reference's
+    slicecache zstd writethrough, internal/slicecache/sliceio.go:53-96).
+    Caller must close() the returned writer (finalizes the zstd frame;
+    the underlying file stays open). Returns None when zstd is
+    unavailable — caller writes plain."""
+    try:
+        import zstandard
+    except ImportError:
+        return None
+    fp.write(ZMAGIC)
+    return zstandard.ZstdCompressor(level=3).stream_writer(
+        fp, closefd=False
+    )
+
+
+class _PushbackReader:
+    """A file-like that replays already-sniffed header bytes."""
+
+    def __init__(self, head: bytes, fp):
+        self._head = head
+        self._fp = fp
+
+    def read(self, n: int = -1) -> bytes:
+        if self._head:
+            if n is None or n < 0 or n >= len(self._head):
+                h, self._head = self._head, b""
+                want = -1 if (n is None or n < 0) else n - len(h)
+                return h + (self._fp.read(want) if want != 0 else b"")
+            h, self._head = self._head[:n], self._head[n:]
+            return h
+        return self._fp.read(n)
+
+
+def maybe_decompressed(fp):
+    """Sniff a stream: ZMAGIC → zstd-decompressing reader; otherwise a
+    reader replaying the sniffed bytes (plain BSF3 files from before
+    compression, or environments without zstd, stay readable)."""
+    head = fp.read(4)
+    if head == ZMAGIC:
+        import zstandard
+
+        return zstandard.ZstdDecompressor().stream_reader(fp)
+    return _PushbackReader(head, fp)
+
+
 class FrameWriter:
     """Streams encoded frames to a binary file object."""
 
@@ -142,16 +191,30 @@ def write_stream(fp: BinaryIO, frames) -> int:
     return w.nrows
 
 
+def _read_exact(fp, n: int) -> bytes:
+    """Read exactly n bytes (looping over short reads — decompressing
+    and remote-object streams legitimately return partial chunks)."""
+    parts = []
+    got = 0
+    while got < n:
+        chunk = fp.read(n - got)
+        if not chunk:
+            break
+        parts.append(chunk)
+        got += len(chunk)
+    return b"".join(parts)
+
+
 def read_stream(fp: BinaryIO) -> Iterator[Frame]:
     """Incrementally decode frames from a file object — one frame's bytes
     resident at a time (spill-merge reads depend on this bound)."""
     while True:
-        header = fp.read(16)
+        header = _read_exact(fp, 16)
         if not header:
             return
         if len(header) < 16 or header[:4] != MAGIC:
             raise CorruptionError("bad frame header in stream")
         (blen, _crc) = struct.unpack_from("<QI", header, 4)
-        body = fp.read(blen)
+        body = _read_exact(fp, blen)
         frame, _ = decode_frame(header + body)
         yield frame

@@ -43,15 +43,15 @@ class ShardCache:
     @staticmethod
     def _usable(path: str) -> bool:
         """A cached shard counts only if it exists AND carries the
-        current codec format — files from older formats are cache
-        misses (recompute + overwrite), not runtime crashes. Mid-file
-        corruption still fails loud at read time (checksums). A 0-byte
-        file is a legitimately empty shard (its reader yielded no
-        frames), not a format mismatch."""
+        current codec format (plain or zstd-compressed) — files from
+        older formats are cache misses (recompute + overwrite), not
+        runtime crashes. Mid-file corruption still fails loud at read
+        time (checksums). A 0-byte file is a legitimately empty shard
+        (its reader yielded no frames), not a format mismatch."""
         try:
             with fileio.open_read(path) as fp:
                 head = fp.read(4)
-                return head == b"" or head == codec.MAGIC
+                return head in (b"", codec.MAGIC, codec.ZMAGIC)
         except (OSError, FileNotFoundError):
             return False
 
@@ -66,16 +66,22 @@ class ShardCache:
         with fileio.open_read(
             shard_path(self.prefix, shard, self.num_shards)
         ) as fp:
-            yield from codec.read_stream(fp)
+            yield from codec.read_stream(codec.maybe_decompressed(fp))
 
     def writethrough(self, shard: int, reader):
         """Tee a shard stream into the cache file, atomically (local
-        tmp+rename; object-store PUT commit)."""
+        tmp+rename; object-store PUT commit), zstd-compressed (the
+        reference's slicecache writethrough; plain when zstd is
+        unavailable — reads sniff either)."""
         path = shard_path(self.prefix, shard, self.num_shards)
         with fileio.atomic_write(path) as fp:
+            zw = codec.open_compressed_write(fp)
+            sink = zw if zw is not None else fp
             for f in reader:
-                fp.write(codec.encode_frame(f))
+                sink.write(codec.encode_frame(f))
                 yield f
+            if zw is not None:
+                zw.close()  # finalize the zstd frame; fp stays open
 
 
 class _CachedSlice(Slice):
