@@ -93,6 +93,13 @@ def _op_base(op: str) -> str:
     return op.split("#", 1)[0]
 
 
+class _AutoDenseRetry(Exception):
+    """An auto-discovered dense-key bound was proven wrong by a later
+    wave's badrange signal: the declaration was retracted and the whole
+    group must re-run on the (range-agnostic) sort path. Internal to
+    _execute_group."""
+
+
 def _looks_like_infra_error(e: BaseException) -> bool:
     """Device-runtime-layer failures (OOM, DMA, runtime wedges) — the
     'machine lost' class: retryable on the host tier, unlike user-code
@@ -198,6 +205,14 @@ class _BridgedStore(store_mod.MemoryStore):
         except store_mod.Missing:
             frames = self.owner._frames_by_name(name, partition)
             if frames is None:
+                # Remotely-owned host task (hostdist): fetch through
+                # the coordination KV, cache locally.
+                hd = self.owner._hostdist
+                if hd is not None:
+                    fetched = hd.fetch(name, partition)
+                    if fetched is not None:
+                        super().put(name, partition, fetched)
+                        return super().read(name, partition)
                 raise
             return iter(frames)
 
@@ -233,9 +248,14 @@ class MeshExecutor:
     name = "mesh"
 
     def __init__(self, mesh, fallback_procs: Optional[int] = None,
-                 ordered_dispatch: bool = False, spmd: bool = False):
+                 ordered_dispatch: bool = False, spmd: bool = False,
+                 auto_dense: bool = True):
         self.mesh = mesh
         self.nmesh = int(mesh.devices.size)
+        # Automatic dense-key discovery (staging-time min/max probe →
+        # table+collective lowering without a dense_keys= annotation).
+        # Off for A/B benchmarks of the generic sort path.
+        self.auto_dense = auto_dense
         # SPMD session mode: this executor is one of N identical
         # processes forming a global mesh (every process runs the same
         # driver program — SURVEY.md §7.1's Func-registry-by-
@@ -256,6 +276,12 @@ class MeshExecutor:
         # Adapted shuffle slack per op (see _execute_wave): overflow
         # probes run once per op, not once per wave/run.
         self._slack_memo: Dict[str, float] = {}
+        # Ops whose auto-discovered dense bound was retracted by a
+        # badrange signal: never re-probe the site (the sort path is
+        # the honest lowering for it). Per-invocation declarations are
+        # NOT memoized — slices are rebuilt per invocation and the
+        # probe is one cheap pass.
+        self._auto_dense_off: set = set()
         # Probation: ops whose device program hit an XLA-runtime
         # failure run on the host fallback until the timestamp passes
         # (single-process only — probation is time-based and local, so
@@ -268,10 +294,20 @@ class MeshExecutor:
         # Keepalive); best-effort — inactive without a real
         # jax.distributed job.
         self._keepalive = None
+        self._hostdist = None
         if self.spmd and self.multiprocess:
             from bigslice_tpu.utils.distributed import get_keepalive
 
             self._keepalive = get_keepalive()
+            # Host-tier tasks run once on a deterministic owner process
+            # and exchange outputs through the coordination KV instead
+            # of running redundantly on every process (hostdist.py,
+            # round-2 verdict #2).
+            from bigslice_tpu.exec.hostdist import HostTaskExchange
+
+            hd = HostTaskExchange(self, keepalive=self._keepalive)
+            if hd.active:
+                self._hostdist = hd
         # Ordered dispatch: ONE dispatcher thread launches device groups
         # strictly in the compile-time plan order the session registers
         # (deterministic by construction — the issue-order discipline
@@ -353,7 +389,14 @@ class MeshExecutor:
             self._plan = keep
             self._ready_cond.notify_all()
         for t in flush:
-            self.local.submit(t)
+            self._submit_host(t)
+
+    def _submit_host(self, task: Task) -> None:
+        """Host-tier submission: owner-routed across SPMD processes
+        when the exchange is live, local otherwise."""
+        if self._hostdist is not None and self._hostdist.submit(task):
+            return  # non-owner: resolves via the exchange poller
+        self.local.submit(task)
 
     def submit(self, task: Task) -> None:
         if not self._eligible(task):
@@ -363,7 +406,7 @@ class MeshExecutor:
                 with self._lock:
                     self._cancelled.add(task.group_key)
                     self._ready_cond.notify_all()
-            self.local.submit(task)
+            self._submit_host(task)
             return
         key = task.group_key
         complete = False
@@ -667,7 +710,7 @@ class MeshExecutor:
             self._cancelled.add(key)
             self._ready_cond.notify_all()
         for t in tasks:
-            self.local.submit(t)
+            self._submit_host(t)
 
     def _run_group(self, key, prepopped=None) -> None:
         if prepopped is None:
@@ -692,7 +735,7 @@ class MeshExecutor:
             # back to the fallback path.
             for t in claimed:
                 t.set_state(TaskState.WAITING)
-                self.local.submit(t)
+                self._submit_host(t)
             return
         try:
             if self._keepalive is not None:
@@ -748,6 +791,16 @@ class MeshExecutor:
     # -- the SPMD program --------------------------------------------------
 
     def _execute_group(self, key, tasks: List[Task]) -> None:
+        try:
+            self._execute_group_inner(key, tasks)
+        except _AutoDenseRetry:
+            # Deterministic across processes: the badrange signal is a
+            # collective output, so every process retracts and re-runs
+            # identically. Nothing was committed (outputs assign only
+            # on success).
+            self._execute_group_inner(key, tasks)
+
+    def _execute_group_inner(self, key, tasks: List[Task]) -> None:
         task0 = tasks[0]
         if len(tasks) > self.nmesh:
             # Wave scheduling: stream ceil(S/N) waves of N shards
@@ -775,6 +828,7 @@ class MeshExecutor:
                       wave: int) -> DeviceGroupOutput:
         task0 = tasks[0]
         inputs = self._group_inputs(tasks, wave)
+        self._maybe_auto_dense(task0, inputs, wave)
         caps = tuple(i[2] for i in inputs)
         counts_list = [i[1] for i in inputs]
         cols_flat = [c for i in inputs for c in i[0]]
@@ -828,6 +882,22 @@ class MeshExecutor:
             )
             has_shuffle = any(k == "shuffle" for k, _, _ in stages)
             if int(np.asarray(badrange)) > 0:
+                auto = self._declared_auto(task0)
+                if auto is not None:
+                    # Our probe was wrong (a later wave holds keys wave
+                    # 0 never saw): retract, blacklist the site, re-run
+                    # the whole group on the sort path.
+                    auto.retract_dense()
+                    auto._auto_declared = False
+                    self._auto_dense_off.add(_op_base(task0.name.op))
+                    # The probing site too (it may be a different
+                    # group — e.g. a producer that declared for its
+                    # consumers): rebuilt slices at that site must not
+                    # re-probe either.
+                    site = getattr(auto, "_auto_site", None)
+                    if site:
+                        self._auto_dense_off.add(site)
+                    raise _AutoDenseRetry()
                 # User error, not skew: match the host tier's range
                 # check (exec/local.py partition_frame) instead of
                 # burning slack retries.
@@ -1008,6 +1078,136 @@ class MeshExecutor:
             self.mesh, per_shard_cols, counts, capacity
         )
         return cols, counts_arr, capacity, False
+
+    # -- automatic dense-key discovery ---------------------------------
+
+    def _dense_candidate(self, task0: Task):
+        """The declarable object (FrameCombiner or Fold) whose key
+        column IS the staged input's column 0 and which opted into
+        auto-discovery — or None. Only mask-level stages (filter/head)
+        may precede the candidate: map/flatmap/join rewrite columns, so
+        a staging-time probe would measure the wrong keys. Join
+        combiners never qualify (auto_dense=False: both sides' shuffles
+        must route identically, which independent per-side probes can't
+        guarantee — exec/combiner.go:39-43's seeded-hash discipline is
+        the analog contract)."""
+        if len(task0.deps) > 1:
+            return None
+        for kind, _, s in self._stages_for(task0):
+            if kind in ("filter", "head"):
+                continue
+            if kind == "shuffle":
+                part = task0.partitioner
+                fc = part.combiner
+                if (fc is not None and getattr(fc, "auto_dense", False)
+                        and fc.dense_keys is None
+                        and part.partition_fn is None
+                        and fc.dense_eligible()):
+                    return fc
+                return None
+            if kind == "combine":
+                fc = s.frame_combiner
+                if (getattr(fc, "auto_dense", False)
+                        and fc.dense_keys is None
+                        and fc.dense_eligible()):
+                    return fc
+                return None
+            if kind == "fold":
+                if (getattr(s, "auto_dense", False)
+                        and s.dense_keys is None
+                        and s.dense_eligible()):
+                    return s
+                return None
+            return None
+        return None
+
+    def _maybe_auto_dense(self, task0: Task, inputs, wave: int) -> None:
+        """VERDICT r2 #5: a user with int32 categorical keys who does
+        not pass dense_keys= should still get the table+collective
+        lowering (32-72x the sort path) when a cheap staging-time
+        min/max probe shows a dense range. Wave 0 only — declaring
+        mid-group would mix dense and sort routing across waves. The
+        probe is a collective (pmin/pmax), so every SPMD process
+        decides identically; the badrange signal + group retry guard
+        misprobes (later waves may hold keys wave 0 never saw)."""
+        if wave != 0 or not self.auto_dense:
+            return
+        opb = _op_base(task0.name.op)
+        if opb in self._auto_dense_off:
+            return
+        cand = self._dense_candidate(task0)
+        if cand is None:
+            return
+        from bigslice_tpu.parallel import dense as dense_mod
+
+        cols, counts, capacity, has_sub = inputs[0]
+        kmin, kmax = self._key_range(cols, counts, capacity, has_sub)
+        k = kmax + 1
+        # League guard (dense_gate's heuristic): a table far larger
+        # than the data beats nothing.
+        if (kmin >= 0 and 0 < k <= dense_mod.MAX_DENSE_KEYS
+                and k <= 2 * capacity and cand.try_declare_dense(k)):
+            cand._auto_declared = True
+            cand._auto_site = opb  # blacklisted too on retraction
+
+    def _declared_auto(self, task0: Task):
+        """The auto-declared object governing this group's dense
+        lowering, if any (for badrange retraction)."""
+        objs = []
+        if task0.num_partition > 1 and task0.partitioner.combiner:
+            objs.append(task0.partitioner.combiner)
+        for s in task0.chain:
+            fc = getattr(s, "frame_combiner", None)
+            if fc is not None:
+                objs.append(fc)
+            if hasattr(s, "dense_op"):
+                objs.append(s)
+        for o in objs:
+            if (getattr(o, "_auto_declared", False)
+                    and getattr(o, "dense_keys", None) is not None):
+                return o
+        return None
+
+    def _key_range(self, cols, counts, capacity: int, has_sub: bool):
+        """Global (min, max) over the valid rows of the staged key
+        column — one bandwidth pass, replicated result on every
+        process."""
+        kidx = 1 if has_sub else 0
+        key = ("keyrange", int(capacity), bool(has_sub))
+        with self._lock:
+            cached = self._programs.get(key)
+        if cached is not None:
+            prog = cached[0]
+        else:
+            import jax
+            import jax.numpy as jnp
+            from jax import lax
+            from jax.sharding import PartitionSpec as P
+
+            axis = mesh_axis(self.mesh)
+            shard_map = get_shard_map()
+            imax = np.int32(np.iinfo(np.int32).max)
+            imin = np.int32(np.iinfo(np.int32).min)
+
+            def body(cnt, kcol):
+                valid = (jnp.arange(kcol.shape[0], dtype=np.int32)
+                         < cnt[0])
+                kmin = jnp.min(jnp.where(valid, kcol, imax))
+                kmax = jnp.max(jnp.where(valid, kcol, imin))
+                # One output array → one host sync at the call site.
+                return jnp.stack([lax.pmin(kmin, axis),
+                                  lax.pmax(kmax, axis)])
+
+            prog = jax.jit(shard_map(
+                body, mesh=self.mesh, in_specs=(P(axis), P(axis)),
+                out_specs=P(), check_rep=False,
+            ))
+            with self._lock:
+                self._programs[key] = (prog, ())
+                while len(self._programs) > _PROGRAM_CACHE_MAX:
+                    self._programs.pop(next(iter(self._programs)))
+        mm = np.asarray(prog(counts, cols[kidx]))
+        return int(mm[0]), int(mm[1])
 
     def _stages_for(self, task: Task) -> List[tuple]:
         """Flatten the chain (innermost→outermost) + output partitioner

@@ -43,6 +43,9 @@ def _is_gang_loss(e: BaseException) -> bool:
         if isinstance(err, TaskError):
             stack.append(err.cause)
         stack.append(err.__cause__)
+        # Implicit chaining too: a HostLostError raised during an except
+        # block without `from` hangs off __context__, not __cause__.
+        stack.append(err.__context__)
     return False
 
 
@@ -391,8 +394,11 @@ class Session:
             time.sleep(0.05)
         mesh = self.mesh_provider() if self.mesh_provider else None
         resize = getattr(self.executor, "resize", None)
-        if resize is not None and mesh is not None:
-            resize(mesh)
+        if resize is None or mesh is None:
+            # No way to swap the dead mesh: retrying would re-evaluate on
+            # the same one and burn every elastic attempt predictably.
+            return False
+        resize(mesh)
         for t in all_tasks:
             if t.state == TaskState.ERR:
                 t.reset_for_retry()

@@ -72,24 +72,41 @@ class Fold(Slice):
         # ignored otherwise (Reduce's dense_keys contract).
         self.dense_keys = None
         self.dense_op = None
-        if (dense_keys is not None and self.device
-                and slice_.prefix == 1
-                and len(slice_.schema) == 2
-                and np.dtype(slice_.schema.cols[0].dtype)
-                == np.dtype(np.int32)
-                and slice_.schema.cols[0].shape == ()
-                and slice_.schema.cols[1].shape == ()
-                and not callable(init)):
-            from bigslice_tpu.parallel import dense
+        # Executors may auto-discover the bound from a staging-time
+        # key-range probe (FrameCombiner.auto_dense contract).
+        self.auto_dense = True
+        if dense_keys is not None:
+            self.try_declare_dense(dense_keys)
 
-            if 0 < dense_keys <= dense.MAX_DENSE_KEYS:
-                op = dense.classified_fold_op_cached(
-                    fn, np.dtype(self.acc_dtype),
-                    np.dtype(slice_.schema.cols[1].dtype),
-                )
-                if op is not None:
-                    self.dense_keys = int(dense_keys)
-                    self.dense_op = op
+    def dense_eligible(self) -> bool:
+        return (self.device and self.dep_slice.prefix == 1
+                and len(self.dep_slice.schema) == 2
+                and np.dtype(self.dep_slice.schema.cols[0].dtype)
+                == np.dtype(np.int32)
+                and self.dep_slice.schema.cols[0].shape == ()
+                and self.dep_slice.schema.cols[1].shape == ()
+                and not callable(self.init))
+
+    def try_declare_dense(self, dense_keys: int) -> bool:
+        if not self.dense_eligible():
+            return False
+        from bigslice_tpu.parallel import dense
+
+        op = None
+        if 0 < dense_keys <= dense.MAX_DENSE_KEYS:
+            op = dense.classified_fold_op_cached(
+                self.fn, np.dtype(self.acc_dtype),
+                np.dtype(self.dep_slice.schema.cols[1].dtype),
+            )
+        if op is None:
+            return False
+        self.dense_keys = int(dense_keys)
+        self.dense_op = op
+        return True
+
+    def retract_dense(self) -> None:
+        self.dense_keys = None
+        self.dense_op = None
 
     def _device_eligible(self) -> bool:
         """Traceable fold fn + scalar device schema + literal init →

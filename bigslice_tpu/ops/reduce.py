@@ -78,26 +78,54 @@ class FrameCombiner:
         # key shape/dtype, host tier) quietly keeps the sort lowering.
         self.dense_keys = None
         self.dense_ops = None
-        if (dense_keys is not None and self.device and self.nkeys == 1
-                and np.dtype(schema.cols[0].dtype) == np.dtype(np.int32)
-                and schema.cols[0].shape == ()):
-            from bigslice_tpu.parallel import dense
+        # Executors may auto-discover a dense bound from the data (a
+        # min/max probe at staging time) when the user declared none.
+        # Off by default: Reduce opts in below; JoinAggregate must NOT
+        # (its two sides' shuffles have to route identically, which
+        # independent per-side discovery can't guarantee).
+        self.auto_dense = False
+        if dense_keys is not None:
+            self.try_declare_dense(dense_keys)
 
-            ops = None
-            # Oversized/invalid bounds quietly keep the sort path
-            # (callers derive the bound from data size — e.g.
-            # dictenc's len(vocab) — and must not start crashing when
-            # the data grows past the table cap). Vector VALUE columns
-            # are fine (rows scatter whole); the KEY must be scalar.
-            if 0 < dense_keys <= dense.MAX_DENSE_KEYS:
-                ops = dense.classified_ops_cached(
-                    fn, self.nvals,
-                    tuple(np.dtype(ct.dtype) for ct in schema.values),
-                    tuple(tuple(ct.shape) for ct in schema.values),
-                )
-            if ops is not None:
-                self.dense_keys = int(dense_keys)
-                self.dense_ops = ops
+    def dense_eligible(self) -> bool:
+        """Structural half of the dense contract: single scalar int32
+        key on the device tier. (The fn-classification half is checked
+        by try_declare_dense.)"""
+        return (self.device and self.nkeys == 1
+                and np.dtype(self.schema.cols[0].dtype)
+                == np.dtype(np.int32)
+                and self.schema.cols[0].shape == ())
+
+    def try_declare_dense(self, dense_keys: int) -> bool:
+        """Declare keys dense in [0, dense_keys); True if the dense
+        lowering engaged. Oversized/invalid bounds quietly keep the
+        sort path (callers derive the bound from data size — e.g.
+        dictenc's len(vocab) — and must not start crashing when the
+        data grows past the table cap). Vector VALUE columns are fine
+        (rows scatter whole); the KEY must be scalar."""
+        if not self.dense_eligible():
+            return False
+        from bigslice_tpu.parallel import dense
+
+        ops = None
+        if 0 < dense_keys <= dense.MAX_DENSE_KEYS:
+            ops = dense.classified_ops_cached(
+                self.fn, self.nvals,
+                tuple(np.dtype(ct.dtype) for ct in self.schema.values),
+                tuple(tuple(ct.shape) for ct in self.schema.values),
+            )
+        if ops is None:
+            return False
+        self.dense_keys = int(dense_keys)
+        self.dense_ops = ops
+        return True
+
+    def retract_dense(self) -> None:
+        """Undo an auto-discovered declaration (a later wave proved the
+        probed bound wrong): programs rebuilt after this use the sort
+        lowering, which is range-agnostic."""
+        self.dense_keys = None
+        self.dense_ops = None
 
     def combine(self, frame: Frame) -> Frame:
         """Combine equal keys within one frame."""
@@ -152,6 +180,11 @@ class Reduce(Slice):
         self._combiner = Combiner(fn, name="reduce")
         self.frame_combiner = FrameCombiner(fn, slice_.schema,
                                             dense_keys=dense_keys)
+        # One FrameCombiner serves both the producer shuffle's map-side
+        # combine and this slice's reduce-side combine, so an executor
+        # discovering a dense key range at the producer automatically
+        # wires the consumer too.
+        self.frame_combiner.auto_dense = True
 
     def deps(self):
         return (Dep(self.dep_slice, shuffle=True, partitioner=None,

@@ -63,9 +63,9 @@ def classify_combine_ops(cfn, val_dtypes: Sequence,
     rng = np.random.RandomState(0)
     if val_shapes is None:
         val_shapes = [() for _ in val_dtypes]
-    a = [_probe_sample(rng, dt, sh) for dt, sh in
+    a = [_probe_sample(rng, dt, sh, slot=0) for dt, sh in
          zip(val_dtypes, val_shapes)]
-    b = [_probe_sample(rng, dt, sh) for dt, sh in
+    b = [_probe_sample(rng, dt, sh, slot=1) for dt, sh in
          zip(val_dtypes, val_shapes)]
     if any(x is None for x in a):
         return None
@@ -94,15 +94,29 @@ def classify_combine_ops(cfn, val_dtypes: Sequence,
 _PROBE_N = 64
 
 
-def _probe_sample(rng, dt, shape=()):
+def _probe_sample(rng, dt, shape=(), slot=0):
+    """Random sample with dtype extremes planted so range-dependent fns
+    (saturating/clipped add, anything that coincides with add/max/min
+    only on small values) fail classification and stay on the sort path,
+    which honors the real fn. Extremes land at disjoint positions per
+    operand slot (the other operand stays small there) so a genuine
+    float add never sees inf + -inf → NaN and misclassifies."""
     dt = np.dtype(dt)
     full = (_PROBE_N,) + tuple(shape)
     if dt.kind == "f":
-        return (rng.randn(*full) * 8).astype(dt)
-    if dt.kind in "iu":
+        out = (rng.randn(*full) * 8).astype(dt)
+        extremes = [np.inf, -np.inf, 0.0, 1e30, -1e30]
+    elif dt.kind in "iu":
         lo, hi = (-(1 << 15), 1 << 15) if dt.kind == "i" else (0, 1 << 16)
-        return rng.randint(lo, hi, full).astype(dt)
-    return None
+        out = rng.randint(lo, hi, full).astype(dt)
+        info = np.iinfo(dt)
+        extremes = [info.min, info.max, 0]
+    else:
+        return None
+    base = slot * len(extremes)
+    for i, v in enumerate(extremes):
+        out[base + i] = dt.type(v)
+    return out
 
 
 def _match_op(out, x, y):

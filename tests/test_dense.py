@@ -114,7 +114,10 @@ def test_dense_matches_sort_path_exactly(mesh):
 
     dense_res = mesh_sess(mesh).run(
         bs.Reduce(bs.Const(8, keys, vals), add, dense_keys=K))
-    sort_res = mesh_sess(mesh).run(
+    # auto_dense=False pins the generic sort path (auto-discovery
+    # would otherwise promote these undeclared dense keys too).
+    sort_sess = Session(executor=MeshExecutor(mesh, auto_dense=False))
+    sort_res = sort_sess.run(
         bs.Reduce(bs.Const(8, keys, vals), add))
     d = dict(dense_res.rows())
     s = dict(sort_res.rows())
