@@ -15,16 +15,33 @@ jax.distributed coordination-service KV store:
 
 - the owner runs the task on its local executor and, on completion,
   publishes each output partition (frame-codec bytes, base64-chunked
-  under the service's message cap) followed by a state marker;
+  under the service's message cap) into an immutable per-attempt
+  namespace (``<task>/a<N>/...``), then flips the task's latest-epoch
+  pointer (``<task>/e``). Epoch namespaces are never mutated after
+  their pointer is published, so a reader that saw epoch N fetches a
+  complete, generation-consistent set of chunks even while the owner
+  is concurrently publishing epoch N+1 (a re-run after output loss);
 - non-owners claim the task, then a single poller thread resolves it
-  when the owner's state marker appears (OK/ERR mirrored exactly);
+  when the owner's epoch pointer appears (OK/ERR mirrored exactly);
   the task's DATA is NOT eagerly copied — a non-owner fetches a
   partition only when something on that process actually reads it
   (consumer-driven movement, the host-tier side of verdict #3);
 - owner loss is detected by the application keepalive
-  (utils.distributed.Keepalive) or an absolute deadline, surfacing as
-  TaskLost so the evaluator's retry ladder (and the session's gang-loss
-  classification) takes over.
+  (utils.distributed.Keepalive). The absolute deadline is reserved for
+  owners with NO liveness signal: while the owner's beat keeps
+  advancing, a slow-but-healthy owner (a big host-tier Cogroup can
+  legitimately run for hours) extends the deadline rather than being
+  falsely marked LOST. Loss surfaces as TaskLost so the evaluator's
+  retry ladder (and the session's gang-loss classification) takes
+  over;
+- the coordination service is not a landfill: an owner deletes a
+  task's previous epoch when it publishes a new one, ``release_run``
+  deletes every non-root task's namespace once all processes have
+  finished the run (cross-process barrier first — a peer may still be
+  lazily fetching until its own run completes), and ``close`` deletes
+  everything this process ever published. Root (result) tasks stay
+  published for the life of the session: a later run's Result reuse or
+  a post-run result scan may still read them from a non-owner.
 
 Machine-combined groups (``machine_combiners=True``) are excluded:
 their shared per-process combiner buffers assume every producer's
@@ -37,9 +54,10 @@ the redundant model's N-times.
 from __future__ import annotations
 
 import base64
+import hashlib
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Set
 
 from bigslice_tpu.exec.task import Task, TaskName, TaskState
 
@@ -47,15 +65,24 @@ from bigslice_tpu.exec.task import Task, TaskName, TaskState
 # gRPC message caps with headroom).
 CHUNK_BYTES = 1 << 20
 
-# How long a non-owner waits for the owner's state marker before
-# judging the task lost (the keepalive usually fires first).
+# How long a non-owner waits for the owner's epoch pointer when the
+# owner has no liveness signal (keepalive inactive or never-observed
+# beat). A beating owner is trusted indefinitely — the keepalive, not
+# the clock, is the loss detector.
 STATE_TIMEOUT_SECS = 600.0
 
 # Poll cadence for the state resolver thread.
 POLL_SECS = 0.1
 
+# How long release_run waits for peers before skipping deletion (a
+# missing peer means the gang is about to fail anyway; keeping the
+# keys is the safe failure mode).
+RELEASE_BARRIER_MS = 15_000
 
-def _task_key(name: TaskName) -> str:
+PREFIX = "bigslice/hostdist"
+
+
+def _base_key(name: TaskName) -> str:
     return f"{name.inv_index}|{name.op}|{name.shard}|{name.num_shard}"
 
 
@@ -78,6 +105,11 @@ class HostTaskExchange:
         self._lock = threading.Lock()
         self._pending: Dict[str, tuple] = {}  # key -> (task, owner, t0)
         self._poller: Optional[threading.Thread] = None
+        # Owner-side bookkeeping for KV hygiene.
+        self._epoch: Dict[str, int] = {}      # base key -> last published
+        self._published: Set[str] = set()     # base keys with live data
+        self._roots: Set[str] = set()         # ever-root base keys (keep)
+        self._barrier_seq: Dict[str, int] = {}
 
     @property
     def active(self) -> bool:
@@ -115,7 +147,7 @@ class HostTaskExchange:
             return True  # another evaluation claimed it
         with self._lock:
             self.remote_count += 1
-            self._pending[_task_key(task.name)] = (
+            self._pending[_base_key(task.name)] = (
                 task, owner, time.monotonic()
             )
             if self._poller is None:
@@ -132,19 +164,16 @@ class HostTaskExchange:
         def on_state(t: Task, state: TaskState) -> None:
             if state == TaskState.OK:
                 try:
-                    self._publish_outputs(t)
-                    self._set(f"{_task_key(t.name)}/state", "ok")
+                    self._publish_epoch(t, "ok")
                 except Exception as e:  # noqa: BLE001
                     # Peers will time out / keepalive out; the run
                     # fails with a classified loss rather than a hang.
-                    self._set_quiet(f"{_task_key(t.name)}/state",
-                                    f"err:publish failed: {e!r}")
+                    self._try_publish_epoch(t, f"err:publish failed: {e!r}")
                 t.unsubscribe(on_state)
                 t._hostdist_pub = False  # re-arm for elastic re-runs
             elif state == TaskState.ERR:
                 err = repr(t.error) if t.error else "task error"
-                self._set_quiet(f"{_task_key(t.name)}/state",
-                                f"err:{err}")
+                self._try_publish_epoch(t, f"err:{err}")
                 t.unsubscribe(on_state)
                 t._hostdist_pub = False
             # LOST: say nothing — the evaluator resubmits and the task
@@ -152,25 +181,67 @@ class HostTaskExchange:
 
         task.subscribe(on_state)
 
-    def _publish_outputs(self, task: Task) -> None:
+    def _publish_epoch(self, task: Task, state: str) -> None:
+        """Publish outputs (when ``state == "ok"``) and the state marker
+        into a fresh immutable epoch namespace, flip the epoch pointer,
+        then garbage-collect the previous epoch."""
         from bigslice_tpu.frame import codec
 
-        key = _task_key(task.name)
-        nparts = max(1, task.num_partition)
-        for p in range(nparts):
-            try:
-                frames = list(self.executor.store.read(task.name, p))
-            except KeyError:
-                frames = []
-            blob = b"".join(codec.encode_frame(f) for f in frames)
-            enc = base64.b64encode(blob).decode("ascii")
-            chunks = [enc[i : i + CHUNK_BYTES]
-                      for i in range(0, len(enc), CHUNK_BYTES)] or [""]
-            for i, c in enumerate(chunks):
-                self._set(f"{key}/p{p}/c{i}", c)
-            self._set(f"{key}/p{p}/n", str(len(chunks)))
+        base = _base_key(task.name)
+        with self._lock:
+            epoch = self._epoch.get(base, -1) + 1
+            self._epoch[base] = epoch
+        ns = f"{base}/a{epoch}"
+        if state == "ok":
+            nparts = max(1, task.num_partition)
+            for p in range(nparts):
+                try:
+                    frames = list(
+                        self.executor.store.read(task.name, p)
+                    )
+                except KeyError:
+                    frames = []
+                blob = b"".join(codec.encode_frame(f) for f in frames)
+                enc = base64.b64encode(blob).decode("ascii")
+                chunks = [enc[i: i + CHUNK_BYTES]
+                          for i in range(0, len(enc), CHUNK_BYTES)] or [""]
+                for i, c in enumerate(chunks):
+                    self._set(f"{ns}/p{p}/c{i}", c)
+                self._set(f"{ns}/p{p}/n", str(len(chunks)))
+        self._set(f"{ns}/state", state)
+        # The pointer is written LAST: a reader that sees epoch N sees
+        # a complete namespace.
+        self._set(f"{base}/e", str(epoch))
+        with self._lock:
+            self._published.add(base)
+        if epoch > 0:
+            self._delete_ns(f"{base}/a{epoch - 1}/")
+
+    def _try_publish_epoch(self, task: Task, state: str) -> None:
+        try:
+            self._publish_epoch(task, state)
+        except Exception:  # noqa: BLE001 — service going down
+            pass
 
     # -- non-owner side ----------------------------------------------------
+
+    def _resolve_state(self, base: str) -> Optional[str]:
+        """The owner's latest state for ``base``, or None if not yet
+        published."""
+        e = self._try_get(f"{base}/e")
+        if e is None:
+            return None
+        return self._try_get(f"{base}/a{int(e)}/state")
+
+    def _owner_beating(self, owner: int) -> bool:
+        """True when the owner has an observed keepalive beat that
+        advanced within the keepalive timeout — a live-and-computing
+        signal that suspends the absolute deadline."""
+        ka = self.keepalive
+        if ka is None or not getattr(ka, "active", False):
+            return False
+        age = ka.age(owner)
+        return age is not None and age < ka.timeout
 
     def _poll_loop(self) -> None:
         while True:
@@ -182,7 +253,7 @@ class HostTaskExchange:
             lost = {p for p, _ in (self.keepalive.lost_peers()
                                    if self.keepalive else [])}
             for key, (task, owner, t0) in items:
-                state = self._try_get(f"{key}/state")
+                state = self._resolve_state(key)
                 if state is not None:
                     with self._lock:
                         self._pending.pop(key, None)
@@ -204,11 +275,21 @@ class HostTaskExchange:
                         f"{task.name} judged lost by keepalive"
                     ))
                 elif time.monotonic() - t0 > STATE_TIMEOUT_SECS:
+                    if self._owner_beating(owner):
+                        # Healthy-but-slow owner: extend. The deadline
+                        # only fires for owners with no liveness signal.
+                        with self._lock:
+                            if key in self._pending:
+                                self._pending[key] = (
+                                    task, owner, time.monotonic()
+                                )
+                        continue
                     with self._lock:
                         self._pending.pop(key, None)
                     task.mark_lost(RuntimeError(
                         f"host task {task.name} unresolved by owner "
-                        f"process {owner} after {STATE_TIMEOUT_SECS}s"
+                        f"process {owner} after {STATE_TIMEOUT_SECS}s "
+                        f"with no liveness signal"
                     ))
             time.sleep(POLL_SECS)
 
@@ -219,28 +300,41 @@ class HostTaskExchange:
         """Fetch a remote task's partition frames, or None if the task
         isn't published (not a distributed host task). Blocks briefly:
         by the time a consumer reads, the owner has already published
-        (state marker follows data), so one pass normally suffices."""
+        (the epoch pointer follows data), so one pass normally
+        suffices."""
         if not self.active:
             return None
         from bigslice_tpu.frame import codec
 
-        key = _task_key(name)
+        base = _base_key(name)
         deadline = time.monotonic() + timeout
+        enc = None
         while True:
-            n = self._try_get(f"{key}/p{partition}/n")
-            if n is not None:
-                break
-            state = self._try_get(f"{key}/state")
-            if state is None or state != "ok" \
-                    or time.monotonic() > deadline:
-                # Never published (not a distributed task), failed
-                # remotely (no data coming), or timed out.
+            e = self._try_get(f"{base}/e")
+            if e is not None:
+                ns = f"{base}/a{int(e)}"
+                if self._try_get(f"{ns}/state") != "ok":
+                    # Failed remotely (no data coming) or a pre-data
+                    # pointer is impossible by construction; treat a
+                    # non-ok state as unpublished.
+                    return None
+                n = self._try_get(f"{ns}/p{partition}/n")
+                chunks = [] if n is None else [
+                    self._try_get(f"{ns}/p{partition}/c{i}")
+                    for i in range(int(n))
+                ]
+                # The owner may republish concurrently and GC the
+                # epoch we were reading mid-assembly; only an
+                # assembly whose epoch pointer is UNCHANGED afterward
+                # is generation-consistent. Otherwise retry on the
+                # new epoch.
+                if (n is not None and None not in chunks
+                        and self._try_get(f"{base}/e") == e):
+                    enc = "".join(chunks)
+                    break
+            if time.monotonic() > deadline:
                 return None
             time.sleep(POLL_SECS)
-        enc = "".join(
-            self._try_get(f"{key}/p{partition}/c{i}") or ""
-            for i in range(int(n))
-        )
         blob = base64.b64decode(enc)
         frames = []
         off = 0
@@ -249,22 +343,75 @@ class HostTaskExchange:
             frames.append(f)
         return frames
 
+    # -- KV hygiene --------------------------------------------------------
+
+    def release_run(self, roots: List[Task]) -> None:
+        """Called on every process after a run completes: barrier, then
+        delete this process's published namespaces for the run's
+        NON-root tasks. Roots stay (post-run result scans and Result
+        reuse read them lazily); a task that was ever a root of any run
+        is never deleted until close()."""
+        if not self.active:
+            return
+        from bigslice_tpu.exec.task import iter_tasks
+
+        root_keys = {_base_key(t.name) for t in roots}
+        all_keys = {_base_key(t.name) for t in iter_tasks(roots)}
+        with self._lock:
+            self._roots |= root_keys
+            doomed = sorted(
+                (all_keys - self._roots) & self._published
+            )
+        # Content-derived barrier id: concurrent session runs may
+        # complete in different orders on different processes, but each
+        # run's graph is identical everywhere, so each run synchronizes
+        # on its own id (sequence-suffixed for repeated identical runs).
+        digest = hashlib.md5(
+            "|".join(sorted(all_keys)).encode()
+        ).hexdigest()[:16]
+        with self._lock:
+            seq = self._barrier_seq.get(digest, 0)
+            self._barrier_seq[digest] = seq + 1
+        try:
+            self.client.wait_at_barrier(
+                f"bigslice_hostdist_release_{digest}_{seq}",
+                RELEASE_BARRIER_MS,
+            )
+        except Exception:  # noqa: BLE001
+            return  # peer missing/slow: keep the keys (safe leak)
+        for base in doomed:
+            self._delete_ns(f"{base}/")
+            with self._lock:
+                self._published.discard(base)
+                self._epoch.pop(base, None)
+
+    def close(self) -> None:
+        """Delete everything this process published (session teardown)."""
+        if not self.active:
+            return
+        with self._lock:
+            doomed = sorted(self._published)
+            self._published.clear()
+            self._epoch.clear()
+        for base in doomed:
+            self._delete_ns(f"{base}/")
+
     # -- KV helpers --------------------------------------------------------
 
     def _set(self, key: str, value: str) -> None:
-        self.client.key_value_set(f"bigslice/hostdist/{key}", value,
+        self.client.key_value_set(f"{PREFIX}/{key}", value,
                                   allow_overwrite=True)
 
-    def _set_quiet(self, key: str, value: str) -> None:
+    def _delete_ns(self, prefix: str) -> None:
+        """Directory-delete every key under ``prefix`` (the service
+        treats a trailing-slash key as a directory)."""
         try:
-            self._set(key, value)
+            self.client.key_value_delete(f"{PREFIX}/{prefix}")
         except Exception:  # noqa: BLE001 — service going down
             pass
 
     def _try_get(self, key: str) -> Optional[str]:
         try:
-            return self.client.key_value_try_get(
-                f"bigslice/hostdist/{key}"
-            )
+            return self.client.key_value_try_get(f"{PREFIX}/{key}")
         except Exception:  # noqa: BLE001 — not present yet
             return None

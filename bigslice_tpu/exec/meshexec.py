@@ -393,10 +393,31 @@ class MeshExecutor:
 
     def _submit_host(self, task: Task) -> None:
         """Host-tier submission: owner-routed across SPMD processes
-        when the exchange is live, local otherwise."""
-        if self._hostdist is not None and self._hostdist.submit(task):
+        when the exchange is live, local otherwise.
+
+        Owner routing is restricted to tasks that are host-tier by
+        COMPILE-TIME classification (mesh-ineligible per _eligible) —
+        identical on every process. Timing-dependent fallbacks
+        (straggler flushes, claim-race releases of device-eligible
+        groups) run locally instead: a process that lost a local claim
+        race must not wait on an "owner" that took the device path and
+        will never publish."""
+        if (self._hostdist is not None and not self._eligible(task)
+                and self._hostdist.submit(task)):
             return  # non-owner: resolves via the exchange poller
         self.local.submit(task)
+
+    def release_run_outputs(self, roots: List[Task]) -> None:
+        """Post-run KV hygiene for distributed host tasks (see
+        hostdist.release_run). No-op without a live exchange."""
+        if self._hostdist is not None:
+            self._hostdist.release_run(roots)
+
+    def close(self) -> None:
+        """Session teardown: delete this process's published host-task
+        outputs from the coordination service."""
+        if self._hostdist is not None:
+            self._hostdist.close()
 
     def submit(self, task: Task) -> None:
         if not self._eligible(task):

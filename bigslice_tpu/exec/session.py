@@ -316,6 +316,14 @@ class Session:
                     if finish is not None:
                         finish(token=run_token)
                 if err is None:
+                    # KV hygiene for distributed host tasks: peers have
+                    # all finished this run (barrier inside), so the
+                    # run's non-root namespaces can be deleted.
+                    release = getattr(
+                        self.executor, "release_run_outputs", None
+                    )
+                    if release is not None:
+                        release(tasks)
                     break
                 if attempts >= self.elastic or not _is_gang_loss(err):
                     raise err
@@ -409,6 +417,9 @@ class Session:
     must = run
 
     def shutdown(self) -> None:
+        close = getattr(self.executor, "close", None)
+        if close is not None:
+            close()
         if self._printer is not None:
             self._printer.stop()
         if self.debug is not None:

@@ -179,6 +179,18 @@ class Keepalive:
             self._lost = lost
         return lost
 
+    def age(self, pid):
+        """Seconds since ``pid``'s beat last ADVANCED (local monotonic
+        clock), or None when no beat has ever been observed — callers
+        (hostdist's slow-owner deadline extension) must treat None as
+        "no liveness signal", not "alive"."""
+        import time
+
+        prev = self._seen.get(pid)
+        if prev is None:
+            return None
+        return time.monotonic() - prev[1]
+
     def lost_peers(self):
         """[(pid, seconds-since-last-advance)] for peers judged lost
         by the monitor (sticky: a peer that beats again after a

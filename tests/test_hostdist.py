@@ -1,0 +1,272 @@
+"""Unit tests for the host-task exchange (exec/hostdist.py) against a
+fake in-memory coordination KV: epoch-immutable publishing, the
+keepalive-extended loss deadline, and KV hygiene (release_run/close).
+The cross-process integration lives in test_multihost.py /
+tools/multihost_smoke.py; these tests pin the mechanics that are hard
+to provoke deterministically across real processes (slow owners,
+republish generations)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from bigslice_tpu.exec import hostdist as hd_mod
+from bigslice_tpu.exec.hostdist import HostTaskExchange, _base_key
+from bigslice_tpu.exec.task import Partitioner, Task, TaskName, TaskState
+from bigslice_tpu.frame import codec
+from bigslice_tpu.frame.frame import Frame
+from bigslice_tpu.slicetype import Schema
+
+
+class FakeKV:
+    """Dict-backed stand-in for the jax coordination client, with
+    directory deletes and a publish log (ordering assertions)."""
+
+    def __init__(self):
+        self.kv = {}
+        self.log = []
+        self.lock = threading.Lock()
+
+    def key_value_set(self, key, value, allow_overwrite=False):
+        with self.lock:
+            self.kv[key] = value
+            self.log.append(("set", key))
+
+    def key_value_try_get(self, key):
+        with self.lock:
+            if key not in self.kv:
+                raise KeyError(key)
+            return self.kv[key]
+
+    def key_value_delete(self, key):
+        with self.lock:
+            if key.endswith("/"):
+                doomed = [k for k in self.kv if k.startswith(key)]
+            else:
+                doomed = [k for k in self.kv if k == key]
+            for k in doomed:
+                del self.kv[k]
+            self.log.append(("del", key))
+
+    def key_value_dir_get(self, key):
+        with self.lock:
+            return [(k, v) for k, v in self.kv.items()
+                    if k.startswith(key)]
+
+    def wait_at_barrier(self, barrier_id, timeout_ms, process_ids=None):
+        self.log.append(("barrier", barrier_id))
+
+
+class FakeStore:
+    def __init__(self, frames_by_name=None):
+        self.frames = frames_by_name or {}
+
+    def read(self, name, partition):
+        try:
+            return iter(self.frames[(name, partition)])
+        except KeyError:
+            raise KeyError((name, partition))
+
+
+class FakeExecutor:
+    def __init__(self, store=None):
+        self.store = store or FakeStore()
+
+
+class FakeKeepalive:
+    def __init__(self, timeout=5.0):
+        self.active = True
+        self.timeout = timeout
+        self._age = {}
+        self._lost = []
+
+    def age(self, pid):
+        return self._age.get(pid)
+
+    def lost_peers(self):
+        return list(self._lost)
+
+
+def make_exchange(nprocs=2, pid=0, keepalive=None, store=None):
+    """Build an exchange without jax.distributed: wire the fakes in
+    directly (the constructor only consults jax when a real client
+    exists)."""
+    ex = HostTaskExchange.__new__(HostTaskExchange)
+    ex.executor = FakeExecutor(store)
+    ex.client = FakeKV()
+    ex.pid = pid
+    ex.nprocs = nprocs
+    ex.keepalive = keepalive
+    ex.owned_count = 0
+    ex.remote_count = 0
+    ex._lock = threading.Lock()
+    ex._pending = {}
+    ex._poller = None
+    ex._epoch = {}
+    ex._published = set()
+    ex._roots = set()
+    ex._barrier_seq = {}
+    return ex
+
+
+def make_task(shard=0, num_shard=2, op="reduce-0", nparts=1, deps=()):
+    name = TaskName(inv_index=1, op=op, shard=shard, num_shard=num_shard)
+    return Task(name, None, list(deps), Partitioner(num_partition=nparts),
+                Schema([np.int32]))
+
+
+def int_frame(vals):
+    return Frame([np.asarray(vals, np.int32)], Schema([np.int32]))
+
+
+def test_publish_epoch_pointer_last_and_gc_of_previous_epoch():
+    t = make_task(shard=0)
+    store = FakeStore({(t.name, 0): [int_frame([1, 2, 3])]})
+    ex = make_exchange(pid=0, store=store)
+    base = _base_key(t.name)
+
+    ex._publish_epoch(t, "ok")
+    kv = ex.client.kv
+    assert kv[f"bigslice/hostdist/{base}/e"] == "0"
+    assert kv[f"bigslice/hostdist/{base}/a0/state"] == "ok"
+    # Pointer written strictly AFTER the epoch's data + state: a reader
+    # that sees /e sees a complete namespace.
+    sets = [k for op_, k in ex.client.log if op_ == "set"]
+    assert sets[-1].endswith("/e")
+    assert sets.index(f"bigslice/hostdist/{base}/a0/state") \
+        < sets.index(f"bigslice/hostdist/{base}/e")
+
+    # Republish (owner re-ran after output loss): new immutable epoch,
+    # pointer flips, previous generation garbage-collected.
+    store.frames[(t.name, 0)] = [int_frame([4, 5, 6])]
+    ex._publish_epoch(t, "ok")
+    assert kv[f"bigslice/hostdist/{base}/e"] == "1"
+    assert not any(f"/{base}/a0/" in k for k in kv), kv.keys()
+    assert kv[f"bigslice/hostdist/{base}/a1/state"] == "ok"
+
+
+def test_fetch_reads_latest_epoch():
+    t = make_task(shard=0)
+    store = FakeStore({(t.name, 0): [int_frame([7, 8])]})
+    ex = make_exchange(pid=0, store=store)
+    ex._publish_epoch(t, "ok")
+    store.frames[(t.name, 0)] = [int_frame([9])]
+    ex._publish_epoch(t, "ok")
+
+    frames = ex.fetch(t.name, 0, timeout=0.5)
+    assert frames is not None
+    (col,) = frames[0].cols
+    assert list(np.asarray(col)) == [9]
+
+
+def test_fetch_returns_none_for_unpublished_and_err():
+    t = make_task(shard=0)
+    ex = make_exchange(pid=0)
+    assert ex.fetch(t.name, 0, timeout=0.05) is None
+    ex._publish_epoch(t, "err:boom")
+    assert ex.fetch(t.name, 0, timeout=0.05) is None
+
+
+def test_slow_owner_with_beating_keepalive_extends_deadline(monkeypatch):
+    """The absolute deadline must NOT fire while the owner's beat keeps
+    advancing: a >deadline host task on a healthy owner stays pending
+    (advisor r3 #1)."""
+    monkeypatch.setattr(hd_mod, "STATE_TIMEOUT_SECS", 0.1)
+    monkeypatch.setattr(hd_mod, "POLL_SECS", 0.01)
+    ka = FakeKeepalive(timeout=5.0)
+    ka._age[1] = 0.5  # owner observed beating recently
+    ex = make_exchange(pid=0, keepalive=ka)
+    t = make_task(shard=1)  # owner = 1 % 2 = process 1
+    t.set_state(TaskState.WAITING)
+    assert ex.submit(t) is True
+    time.sleep(0.5)  # several deadline periods
+    assert t.state == TaskState.RUNNING  # still waiting, not LOST
+
+    # Signal vanishes (owner silent beyond keepalive timeout): the
+    # absolute deadline takes over and the task is judged lost.
+    ka._age[1] = 10.0
+    deadline = time.monotonic() + 5.0
+    while t.state == TaskState.RUNNING and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert t.state == TaskState.LOST
+
+
+def test_owner_lost_by_keepalive_marks_lost(monkeypatch):
+    monkeypatch.setattr(hd_mod, "POLL_SECS", 0.01)
+    ka = FakeKeepalive()
+    ex = make_exchange(pid=0, keepalive=ka)
+    t = make_task(shard=1)
+    t.set_state(TaskState.WAITING)
+    assert ex.submit(t) is True
+    ka._lost = [(1, 42.0)]
+    deadline = time.monotonic() + 5.0
+    while t.state == TaskState.RUNNING and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert t.state == TaskState.LOST
+
+
+def test_remote_ok_resolves_via_epoch_pointer(monkeypatch):
+    monkeypatch.setattr(hd_mod, "POLL_SECS", 0.01)
+    ex = make_exchange(pid=0)
+    t = make_task(shard=1)
+    t.set_state(TaskState.WAITING)
+    assert ex.submit(t) is True
+    # Simulate the remote owner publishing epoch 0.
+    owner = make_exchange(pid=1, store=FakeStore(
+        {(t.name, 0): [int_frame([1])]}
+    ))
+    owner.client = ex.client  # shared KV
+    owner._publish_epoch(make_task(shard=1), "ok")
+    deadline = time.monotonic() + 5.0
+    while t.state != TaskState.OK and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert t.state == TaskState.OK
+
+
+def test_release_run_keeps_roots_deletes_intermediates():
+    root = make_task(shard=0, op="reduce-0")
+    inter = make_task(shard=0, op="map-0")
+    root.deps = []
+    store = FakeStore({
+        (root.name, 0): [int_frame([1])],
+        (inter.name, 0): [int_frame([2])],
+    })
+    ex = make_exchange(pid=0, store=store)
+    ex._publish_epoch(root, "ok")
+    ex._publish_epoch(inter, "ok")
+
+    # Wire the dep graph: root depends on inter.
+    from bigslice_tpu.exec.task import TaskDep
+
+    root.deps = (TaskDep(tasks=(inter,), partition=0),)
+
+    ex.release_run([root])
+    keys = list(ex.client.kv)
+    assert any(_base_key(root.name) in k for k in keys)
+    assert not any(_base_key(inter.name) in k for k in keys), keys
+    # A barrier preceded deletion (peers may still be fetching).
+    kinds = [k for k, _ in ex.client.log]
+    assert "barrier" in kinds
+
+    # An ever-root task survives later runs where it appears as an
+    # intermediate (Result reuse), until close().
+    outer = make_task(shard=0, op="fold-0")
+    store.frames[(outer.name, 0)] = [int_frame([3])]
+    ex._publish_epoch(outer, "ok")
+    outer.deps = (TaskDep(tasks=(root,), partition=0),)
+    ex.release_run([outer])
+    keys = list(ex.client.kv)
+    assert any(_base_key(root.name) in k for k in keys), keys
+
+    ex.close()
+    assert not ex.client.kv, ex.client.kv
+
+
+def test_distributable_excludes_machine_combined():
+    ex = make_exchange()
+    t = make_task()
+    assert ex.distributable(t)
+    t.partitioner.combine_key = "mc-1"
+    assert not ex.distributable(t)

@@ -25,6 +25,10 @@ def test_two_process_distributed_smoke():
     # group launch → collective execution → result scan) across the
     # two processes with the device path engaged.
     assert "MULTIHOST_SESSION_OK" in out.stdout
+    # Host-tier (object-key) tasks were owner-routed across the two
+    # processes — each owned some and resolved the rest remotely —
+    # and the coordination KV was left empty at teardown.
+    assert "HOSTDIST_OK" in out.stdout
 
 
 def test_wedged_peer_detected_by_keepalive():
