@@ -48,6 +48,10 @@ from bigslice_tpu.parallel import shuffle as shuffle_mod
 # the fallback executor rather than waiting forever.
 GROUP_WAIT_SECS = 0.25
 
+# How long a store-bridge reader waits for a queued (dispatcher-ordered)
+# late gather of a mesh-resident output before judging it failed.
+GATHER_WAIT_SECS = 120.0
+
 # Compiled SPMD programs kept per executor (FIFO-evicted): iterative
 # drivers that rebuild chains each round must not grow the cache (and its
 # compiled executables) without bound.
@@ -61,6 +65,15 @@ class HostLostError(RuntimeError):
     whole step. Recovery is program-level: restart the SPMD driver
     (every process), and Cache/store materialization short-circuits
     recomputation of finished stages."""
+
+
+class UngatheredOutputError(RuntimeError):
+    """A host read reached a mesh-resident (device-only) multiprocess
+    output outside the planned gather order. Running the collective
+    lazily would deadlock across processes, so the store bridge
+    converts this to Missing — the retriable contract (Result.reader
+    mark_lost + re-eval; DepLost for task-level reads) — and resize
+    treats such outputs as unsalvageable (tasks LOST, recomputed)."""
 
 
 # Multi-word, runtime-specific markers only: a user error merely
@@ -169,11 +182,32 @@ class DeviceGroupOutput:
                 cols, counts, self.capacity
             )
 
+    @property
+    def gathered(self) -> bool:
+        """Host-readable without a collective: chunks materialized, or
+        the arrays are fully addressable (single-process mesh)."""
+        if self._chunks is not None or self.cols is None:
+            return True
+        return bool(getattr(self.cols[0], "is_fully_addressable", True))
+
     def host_chunks(self) -> List[List[np.ndarray]]:
         # Memoized: every (task, partition) read would otherwise pull the
         # whole global output device→host again.
         with self._chunks_lock:
             if self._chunks is None:
+                if self.cols and not getattr(
+                    self.cols[0], "is_fully_addressable", True
+                ):
+                    # Multiprocess output that consumer-driven gather
+                    # marked device-only: a lazy host read cannot run
+                    # the collective (nondeterministic order across
+                    # processes). Settle the reader as a classified
+                    # error; the retry/elastic ladder recomputes.
+                    raise UngatheredOutputError(
+                        "device group output is mesh-resident "
+                        "(device-only by plan); host read would need "
+                        "an unplanned collective gather"
+                    )
                 self._chunks = shuffle_mod.unshard_columns(
                     self.cols, np.asarray(self.counts), self.capacity
                 )
@@ -203,7 +237,15 @@ class _BridgedStore(store_mod.MemoryStore):
         try:
             return super().read(name, partition)
         except store_mod.Missing:
-            frames = self.owner._frames_by_name(name, partition)
+            try:
+                frames = self.owner._frames_by_name(name, partition)
+            except UngatheredOutputError as e:
+                # Mesh-resident (device-only) output read outside the
+                # planned gather order: surface as Missing — the
+                # retriable store contract (Result.reader's
+                # mark_lost + re-eval; DepLost for task reads) —
+                # instead of a sticky terminal error.
+                raise store_mod.Missing(name, partition) from e
             if frames is None:
                 # Remotely-owned host task (hostdist): fetch through
                 # the coordination KV, cache locally.
@@ -234,6 +276,24 @@ class WavedGroupOutput:
     def gather(self) -> None:
         for w in self.waves:
             w.gather()
+
+    @property
+    def gathered(self) -> bool:
+        return all(w.gathered for w in self.waves)
+
+
+class _GatherEntry:
+    """A dispatcher-ordered late-gather debt in the launch plan: an
+    already-executed, mesh-resident group output that a newly planned
+    run reads on host (Result reuse feeding a host consumer, or a
+    former intermediate becoming a root). Collectives must run in plan
+    order on the single dispatcher thread — never lazily from reader
+    threads."""
+
+    __slots__ = ("key",)
+
+    def __init__(self, key):
+        self.key = key
 
 
 class _GroupState:
@@ -326,6 +386,18 @@ class MeshExecutor:
         self._cancelled: set = set()
         self._ready_cond = threading.Condition(self._lock)
         self._dispatcher: Optional[threading.Thread] = None
+        # Consumer-driven gather (round-2 verdict #3): groups whose
+        # outputs are read on host (roots, host-tier consumers,
+        # misaligned device consumers) are marked at plan time; only
+        # those gather cross-process. Device-chained intermediates stay
+        # mesh-resident — no O(global data) DCN traffic per group.
+        # Key → run token: finish_run purges a run's marks (group keys
+        # are per-compilation, so iterative drivers would otherwise
+        # grow these without bound; every _run_group gather decision
+        # happens before its tasks turn OK, i.e. before finish_run).
+        self._gather_analyzed: Dict = {}
+        self._gather_marked: Dict = {}
+        self._gather_pending: set = set()
 
     def start(self, session) -> None:
         self.session = session
@@ -357,7 +429,83 @@ class MeshExecutor:
                 self._dispatcher.start()
             self._ready_cond.notify_all()
 
-    def finish_run(self, token=None) -> None:
+    def plan_gather(self, roots, token=None) -> None:
+        """Consumer-driven gather analysis (round-2 verdict #3; the
+        data-plane side of SURVEY §5.8): called by the session before
+        plan_groups. Marks which of the run's device groups have
+        host-read outputs — the run's ROOTS (result scans), producers
+        feeding mesh-INELIGIBLE consumers, and producers whose device
+        consumers read through the store bridge (unpartitioned deps
+        merging multiple producer tasks). Everything else stays
+        mesh-resident: a device-chained intermediate never crosses DCN.
+
+        Already-executed, still-resident outputs that this run newly
+        reads on host (Result reuse feeding a host consumer; a former
+        intermediate re-rooted) become _GatherEntry debts at the FRONT
+        of the plan: the dispatcher runs their collectives in plan
+        order before launching this run's groups. The analysis uses
+        only compile-time state (task graph, _eligible without
+        probation in SPMD mode), so every process computes the same
+        marks."""
+        if not self.multiprocess or not self.ordered_dispatch:
+            return
+        from bigslice_tpu.exec.task import iter_tasks
+
+        need: Dict = {}  # insertion-ordered — deterministic across processes
+        analyzed = []
+        for t in iter_tasks(roots):
+            if t.group_key is not None:
+                analyzed.append(t.group_key)
+            if t.state == TaskState.OK:
+                continue  # won't re-run; reads no deps
+            for d in t.deps:
+                pkey = d.tasks[0].group_key
+                if pkey is None:
+                    continue
+                if self._consumer_reads_host(t, d):
+                    need[pkey] = None
+        for rt in roots:
+            if rt.group_key is not None:
+                need[rt.group_key] = None
+        with self._lock:
+            for k in analyzed:
+                self._gather_analyzed[k] = token
+            for k in need:
+                self._gather_marked[k] = token
+            queued = False
+            for k in need:
+                out = self._outputs.get(k)
+                if (out is not None and not out.gathered
+                        and k not in self._gather_pending):
+                    entry = _GatherEntry(k)
+                    self._plan.append(entry)
+                    self._plan_set.add(entry)
+                    self._plan_token[entry] = token
+                    self._gather_pending.add(k)
+                    queued = True
+            if queued:
+                if self._dispatcher is None:
+                    self._dispatcher = threading.Thread(
+                        target=self._dispatch_loop, daemon=True
+                    )
+                    self._dispatcher.start()
+                self._ready_cond.notify_all()
+
+    def _consumer_reads_host(self, consumer: Task, dep) -> bool:
+        """Does ``consumer`` read ``dep``'s device output through the
+        store bridge (host materialization)? Mirrors _dep_input's
+        zero-copy conditions, restricted to compile-time facts."""
+        if not self._eligible(consumer):
+            return True
+        if dep.tasks[0].num_partition > 1:
+            # Partitioned (shuffle) outputs are device-addressed for
+            # any consumer shape, including wave-partitioned subid.
+            return False
+        # Unpartitioned: only aligned single-producer deps chain
+        # zero-copy (device s holds producer shard s).
+        return len(dep.tasks) != 1
+
+    def finish_run(self, token=None, failed: bool = True) -> None:
         """Called by the session when an evaluation completes (success
         or error): this run's remaining plan entries will never receive
         further submissions (group keys are per-compilation), so drop
@@ -365,7 +513,14 @@ class MeshExecutor:
         the fallback so they still settle — rather than wedging the
         dispatcher (and every later run queued behind) forever.
         Deterministic across SPMD processes, as evaluation outcomes
-        are."""
+        are.
+
+        ``failed`` distinguishes the two debt fates: an ABORTED run's
+        unpaid late-gather debts are dropped (their collective could
+        never complete across processes), while a SUCCESSFUL run's are
+        kept in the plan for the dispatcher — an all-OK reuse run
+        finishes evaluation instantly, usually before the dispatcher
+        has paid the debt its result scan is about to wait on."""
         if not self.ordered_dispatch:
             return
         flush = []
@@ -374,6 +529,18 @@ class MeshExecutor:
             for k in self._plan:
                 if self._plan_token.get(k) != token:
                     keep.append(k)  # another run's entry
+                    continue
+                if isinstance(k, _GatherEntry):
+                    if not failed:
+                        keep.append(k)  # dispatcher will pay it
+                        continue
+                    # Unpaid debt of an aborted run: drop it (its
+                    # collective could not complete) and wake waiting
+                    # readers — they settle via the
+                    # UngatheredOutputError → Missing path.
+                    self._plan_set.discard(k)
+                    self._plan_token.pop(k, None)
+                    self._gather_pending.discard(k.key)
                     continue
                 g = self._groups.get(k)
                 if g is not None and not g.launched:
@@ -387,6 +554,11 @@ class MeshExecutor:
                 self._plan_token.pop(k, None)
                 self._cancelled.discard(k)
             self._plan = keep
+            # This run's gather marks are spent: every gather decision
+            # for its groups happened before their tasks turned OK.
+            for d in (self._gather_analyzed, self._gather_marked):
+                for k in [k for k, t in d.items() if t == token]:
+                    del d[k]
             self._ready_cond.notify_all()
         for t in flush:
             self._submit_host(t)
@@ -515,6 +687,13 @@ class MeshExecutor:
                         # Salvage AND drop device residency: the old
                         # arrays are sharded over the outgoing mesh and
                         # must never zero-copy into new-mesh programs.
+                        # Mesh-resident (device-only) multiprocess
+                        # outputs are INTENTIONALLY unsalvageable: the
+                        # collective gather is unsafe mid-resize (the
+                        # old mesh may include dead hosts), so
+                        # host_chunks raises UngatheredOutputError and
+                        # the except below marks their tasks LOST for
+                        # recomputation on the new mesh.
                         w.drop_device()
                 except Exception as e:  # device data died with the mesh
                     del self._outputs[key]
@@ -659,11 +838,20 @@ class MeshExecutor:
         while True:
             key = None
             members = None
+            gather_action = None
             with self._lock:
                 while True:
                     while not self._plan:
                         self._ready_cond.wait()
                     head = self._plan[0]
+                    if isinstance(head, _GatherEntry):
+                        # Late-gather debt: run its collective here, in
+                        # plan order, before later groups launch.
+                        self._pop_head(head)
+                        gather_action = (
+                            head.key, self._outputs.get(head.key)
+                        )
+                        break
                     if head in self._cancelled:
                         self._pop_head(head)
                         self._cancelled.discard(head)
@@ -703,6 +891,18 @@ class MeshExecutor:
                             break
                         continue  # fully satisfied: nothing to launch
                     self._ready_cond.wait(timeout=0.05)
+            if gather_action is not None:
+                gkey, gout = gather_action
+                try:
+                    if gout is not None:
+                        gout.gather()
+                except Exception:  # noqa: BLE001 — readers settle via
+                    pass           # the UngatheredOutputError path
+                finally:
+                    with self._lock:
+                        self._gather_pending.discard(gkey)
+                        self._ready_cond.notify_all()
+                continue
             try:
                 if members is not None:
                     self._run_group(key, prepopped=members)
@@ -769,9 +969,16 @@ class MeshExecutor:
                     self._task_index[t.name] = (key, t)
                 out = self._outputs.get(key)
             if self.multiprocess and out is not None:
-                # Eager cross-process gather in launch order (see
-                # DeviceGroupOutput.gather).
-                out.gather()
+                with self._lock:
+                    device_only = (key in self._gather_analyzed
+                                   and key not in self._gather_marked)
+                if not device_only:
+                    # Cross-process gather in launch order (see
+                    # DeviceGroupOutput.gather) — only for groups whose
+                    # outputs are host-read per plan_gather; unanalyzed
+                    # groups (no planning session) gather eagerly.
+                    # Device-chained intermediates never cross DCN.
+                    out.gather()
             for t in claimed:
                 t.mark_ok()
         except DepLost as e:
@@ -1695,6 +1902,14 @@ class MeshExecutor:
             if entry is None:
                 return None
             key, task = entry
+            if self.multiprocess and key in self._gather_pending:
+                # A dispatcher-ordered late gather of this output is
+                # queued (plan_gather debt): wait for it rather than
+                # racing the collective from a reader thread.
+                self._ready_cond.wait_for(
+                    lambda: key not in self._gather_pending,
+                    timeout=GATHER_WAIT_SECS,
+                )
             out = self._outputs.get(key)
         if out is None:
             return None

@@ -314,7 +314,7 @@ class Session:
                     # they settle (the recover step waits for them).
                     finish = getattr(self.executor, "finish_run", None)
                     if finish is not None:
-                        finish(token=run_token)
+                        finish(token=run_token, failed=err is not None)
                 if err is None:
                     # KV hygiene for distributed host tasks: peers have
                     # all finished this run (barrier inside), so the
@@ -370,6 +370,12 @@ class Session:
                 order.append(t.group_key)
             groups[t.group_key].append(t)
         run_token = object()  # collision-free per-run identity
+        # Consumer-driven gather marks (and any late-gather debts for
+        # already-resident outputs this run reads on host) must precede
+        # the group entries in the dispatch plan.
+        plan_gather = getattr(self.executor, "plan_gather", None)
+        if plan_gather is not None:
+            plan_gather(tasks, token=run_token)
         plan_groups(
             ((k, groups[k]) for k in order
              if not all(m.state == TaskState.OK

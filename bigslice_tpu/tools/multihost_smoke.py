@@ -131,6 +131,18 @@ def worker(num_processes: int, process_id: int, port: int,
     assert got == expect, (got, expect)
     assert sess.executor.device_group_count() >= 2
 
+    # Consumer-driven gather (meshexec.plan_gather): the shuffle-write
+    # producer group is consumed on-device by the reduce (partitioned
+    # zero-copy chain) and must stay mesh-resident — its data never
+    # crosses DCN. Only the root (result-scanned) group gathers.
+    ex = sess.executor
+    with ex._lock:
+        outs = dict(ex._outputs)
+    assert any(not o.gathered for o in outs.values()), \
+        "a device-chained intermediate should stay mesh-resident"
+    assert any(o.gathered for o in outs.values()), \
+        "the root output must gather for result scans"
+
     ak = rng.randint(0, 13, n * 16).astype(np.int32)
     bk = rng.randint(5, 18, n * 16).astype(np.int32)
     join = bs.JoinAggregate(
@@ -166,6 +178,46 @@ def worker(num_processes: int, process_id: int, port: int,
     base = sess.run(bs.Const(n, np.arange(n * 8, dtype=np.int32)))
     doubled = sorted(sess.run(bs.Map(base, lambda x: x * 2)).rows())
     assert doubled == [(2 * i,) for i in range(n * 8)]
+
+    # Mixed-tier gather marking: a device producer feeding a HOST-tier
+    # consumer (object-keyed Map) is marked at plan time and gathers at
+    # production, while device-consumed intermediates from earlier runs
+    # stay mesh-resident throughout (their data never crosses DCN).
+    shared_keys = rng.randint(0, 6, n * 16).astype(np.int32)
+    shared = bs.Reduce(
+        bs.Const(n, shared_keys, np.ones(len(shared_keys), np.int32)),
+        add,
+    )
+    dev_rows = dict(sess.run(
+        bs.Map(shared, lambda k, v: (k, v * 2))
+    ).rows())
+    with ex._lock:
+        outs_before = set(ex._outputs)
+        resident_before = {k for k, o in ex._outputs.items()
+                           if not o.gathered}
+    assert resident_before  # shared producer output lives on-mesh
+    host_rows = dict(sess.run(
+        bs.Map(shared, lambda k, v: (str(k), v + 100),
+               out=[str, np.int32])
+    ).rows())
+    expect_s: dict = {}
+    for kk in shared_keys.tolist():
+        expect_s[kk] = expect_s.get(kk, 0) + 1
+    assert dev_rows == {k: 2 * c for k, c in expect_s.items()}
+    assert host_rows == {str(k): c + 100 for k, c in expect_s.items()}, \
+        host_rows
+    with ex._lock:
+        new_outs = {k: o for k, o in ex._outputs.items()
+                    if k not in outs_before}
+        still_resident = {k for k, o in ex._outputs.items()
+                          if not o.gathered}
+    # The host-tier run's only device group is its producer — gathered
+    # because its consumer is mesh-ineligible (no root device group:
+    # the root chain itself is host-tier).
+    assert new_outs and all(o.gathered for o in new_outs.values()), \
+        new_outs
+    # Nothing device-consumed was dragged across DCN by the host run.
+    assert resident_before <= still_resident
 
     # 4. Host-tier distribution (exec/hostdist.py): object (string)
     # keys are mesh-ineligible, so these tasks route through the

@@ -636,3 +636,84 @@ def test_vector_value_reduce_on_mesh(mesh):
                                        rtol=1e-4, atol=1e-4)
     # The vector-payload group genuinely engaged the device path.
     assert sess.executor.device_group_count() >= 2
+
+
+class _FakeOut:
+    """Stand-in group output for gather-plan tests."""
+
+    def __init__(self):
+        self.gather_calls = 0
+        self._gathered = False
+
+    def gather(self):
+        self.gather_calls += 1
+        self._gathered = True
+
+    @property
+    def gathered(self):
+        return self._gathered
+
+
+def _mk_task(op, shard, num_shard, group_key, deps=(), chain=None,
+             num_partition=1):
+    from bigslice_tpu.exec.task import (
+        Partitioner, Task, TaskDep, TaskName,
+    )
+    from bigslice_tpu.slicetype import Schema
+
+    t = Task(
+        TaskName(inv_index=1, op=op, shard=shard, num_shard=num_shard),
+        None,
+        [TaskDep(tasks=tuple(d), partition=0) for d in deps],
+        Partitioner(num_partition=num_partition),
+        Schema([np.int32]),
+    )
+    t.group_key = group_key
+    t.chain = chain  # None => mesh-ineligible (host tier)
+    return t
+
+
+def test_plan_gather_marks_and_pays_late_debt(mesh):
+    """Consumer-driven gather: (a) producers feeding host-tier
+    consumers and run roots are marked; device-consumed partitioned
+    producers are not; (b) an already-resident unmarked output that a
+    re-plan newly marks becomes a _GatherEntry debt the dispatcher
+    pays in plan order (the elastic-replan safety net)."""
+    ex = MeshExecutor(mesh)
+    ex.multiprocess = True  # exercise the SPMD-only plan logic
+    ex.ordered_dispatch = True
+
+    # Producer group P (partitioned shuffle output) feeding a host-tier
+    # consumer C (chain None -> ineligible).
+    prods = [_mk_task("const-0", s, 2, "P", num_partition=2)
+             for s in range(2)]
+    cons = [_mk_task("map-0", s, 2, "C", deps=[prods]) for s in range(2)]
+    out = _FakeOut()
+    ex._outputs["P"] = out
+    ex.plan_gather(cons, token="t1")
+    assert "P" in ex._gather_marked          # host consumer => marked
+    assert "C" in ex._gather_marked          # run root => marked
+    assert {"P", "C"} <= set(ex._gather_analyzed)
+    # Resident + newly marked => queued as a dispatcher debt, paid
+    # in plan order by the (single) dispatcher thread.
+    import time
+    deadline = time.monotonic() + 10.0
+    while not out.gathered and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert out.gather_calls == 1
+    with ex._lock:
+        assert "P" not in ex._gather_pending
+
+    # Device-consumed partitioned producer: NOT marked — its data stays
+    # mesh-resident. (The device consumer C2 is itself read by the
+    # host-tier root R, so C2 IS marked.)
+    from bigslice_tpu.ops.const import Const
+    prods2 = [_mk_task("const-1", s, 2, "P2", num_partition=2)
+              for s in range(2)]
+    chain = (Const(2, np.arange(8, dtype=np.int32)),)
+    dev_cons = [_mk_task("reduce-1", s, 2, "C2", deps=[prods2],
+                         chain=chain) for s in range(2)]
+    roots = [_mk_task("tail-1", 0, 1, "R", deps=[dev_cons])]
+    ex.plan_gather(roots, token="t2")
+    assert "P2" not in ex._gather_marked     # device-chained, stays put
+    assert "C2" in ex._gather_marked         # feeds the host-tier root
