@@ -52,6 +52,10 @@ GROUP_WAIT_SECS = 0.25
 # late gather of a mesh-resident output before judging it failed.
 GATHER_WAIT_SECS = 120.0
 
+# Starting group capacity for the device Cogroup lowering; the retry
+# ladder grows it to the observed max group size (parallel/cogroup.py).
+COGROUP_DEFAULT_CAP = 8
+
 # Compiled SPMD programs kept per executor (FIFO-evicted): iterative
 # drivers that rebuild chains each round must not grow the cache (and its
 # compiled executables) without bound.
@@ -336,6 +340,10 @@ class MeshExecutor:
         # Adapted shuffle slack per op (see _execute_wave): overflow
         # probes run once per op, not once per wave/run.
         self._slack_memo: Dict[str, float] = {}
+        # Discovered Cogroup group capacities per op (the segmented-
+        # count probe IS the failed attempt's collective deficit; see
+        # the cogroup retry in _execute_wave).
+        self._cogroup_caps: Dict[str, int] = {}
         # Ops whose auto-discovered dense bound was retracted by a
         # badrange signal: never re-probe the site (the sort path is
         # the honest lowering for it). Per-invocation declarations are
@@ -743,6 +751,26 @@ class MeshExecutor:
             if _time.monotonic() < until:
                 return False  # device path on probation for this op
             self._probation.pop(_op_base(task.name.op), None)
+        from bigslice_tpu.ops.cogroup import Cogroup
+
+        if isinstance(task.chain[-1], Cogroup):
+            # General Cogroup lowers to the tagged-sort group kernel
+            # (parallel/cogroup.py) with executor-discovered capacity.
+            # Its OUTPUT schema is host (ragged object lists — decoded
+            # from the padded device encoding at the store bridge), so
+            # eligibility is judged on the INPUT schemas. Fused outer
+            # stages would operate on object rows: host tier.
+            part = task.partitioner
+            if part.combine_key or any(d.combine_key
+                                       for d in task.deps):
+                return False
+            return (len(task.chain) == 1
+                    and task.num_partition == 1
+                    and all(
+                        all(ct.is_device and ct.shape == ()
+                            for ct in sl.schema)
+                        for sl in task.chain[-1].slices
+                    ))
         if not all(ct.is_device for ct in task.schema):
             return False
         if task.num_partition > 1 and not all(
@@ -1135,6 +1163,20 @@ class MeshExecutor:
                     f"declared dense_keys range, in group "
                     f"{task0.name.op}"
                 )
+            from bigslice_tpu.ops.cogroup import Cogroup as _Cogroup
+
+            if (isinstance(task0.chain[-1], _Cogroup)
+                    and int(np.asarray(overflow)) > 0):
+                # Cogroup capacity deficit (collective pmax — identical
+                # on every process): grow to the observed max group
+                # size and recompile. The failed attempt IS the
+                # segmented-count probe; one retry converges.
+                base = _op_base(task0.name.op)
+                cur = self._cogroup_caps.get(base, COGROUP_DEFAULT_CAP)
+                self._cogroup_caps[base] = bucket_size(
+                    cur + int(np.asarray(overflow))
+                )
+                continue
             if not has_shuffle or int(np.asarray(overflow)) == 0:
                 break
             # slack == ndest makes overflow impossible (a source can
@@ -1440,6 +1482,7 @@ class MeshExecutor:
     def _stages_for(self, task: Task) -> List[tuple]:
         """Flatten the chain (innermost→outermost) + output partitioner
         into device stage descriptors (kind, struct_id, slice)."""
+        from bigslice_tpu.ops.cogroup import Cogroup
         from bigslice_tpu.ops.fold import Fold
         from bigslice_tpu.ops.groupby import GroupByKey
         from bigslice_tpu.ops.join import JoinAggregate
@@ -1474,6 +1517,20 @@ class MeshExecutor:
                 ))
             elif isinstance(s, GroupByKey):
                 stages.append(("groupby", (s.prefix, s.capacity), s))
+            elif isinstance(s, Cogroup):
+                # Capacity is executor-discovered (retry ladder in
+                # _execute_wave); it keys the compiled program.
+                G = self._cogroup_caps.get(
+                    _op_base(task.name.op), COGROUP_DEFAULT_CAP
+                )
+                stages.append((
+                    "cogroup",
+                    (s.prefix,
+                     tuple(len(sl.schema) - sl.prefix
+                           for sl in s.slices),
+                     G),
+                    s,
+                ))
             elif isinstance(s, JoinAggregate):
                 fa, fb = s.frame_combiners
                 stages.append((
@@ -1665,6 +1722,21 @@ class MeshExecutor:
                                                 col_sets)
                 badrange = badrange + jbad
                 run_stages = stages[1:]
+            elif stages and stages[0][0] == "cogroup":
+                # N-ary ragged grouping: one tagged sort over the
+                # union of inputs, rank-scattered into fixed-capacity
+                # matrices (parallel/cogroup.py). The deficit rides
+                # the overflow signal into the capacity retry ladder.
+                from bigslice_tpu.parallel.cogroup import (
+                    make_cogroup_align,
+                )
+
+                _, (cnk, cnv, cG), _s = stages[0]
+                mask, cols, deficit = make_cogroup_align(
+                    cnk, cnv, cG, axis
+                )(masks, col_sets)
+                overflow = overflow + deficit
+                run_stages = stages[1:]
             else:
                 cols = col_sets[0]
                 mask = masks[0]
@@ -1828,7 +1900,14 @@ class MeshExecutor:
             out_n, cols = segment.compact_by_mask(mask, cols)
             return (out_n.reshape(1), overflow, badrange, tuple(cols))
 
-        ncols_out = len(task.schema) + (1 if out_subid else 0)
+        if stages and stages[0][0] == "cogroup":
+            # Device view of the ragged output: keys, then per input
+            # its value matrices and a count column (decoded to the
+            # object-list schema at the store bridge).
+            _, (cnk, cnv, _cG), _cs = stages[0]
+            ncols_out = cnk + sum(cnv) + len(cnv)
+        else:
+            ncols_out = len(task.schema) + (1 if out_subid else 0)
         col_spec = P(axis)
         in_specs = (
             (P(),)  # wave scalar (replicated)
@@ -1913,6 +1992,26 @@ class MeshExecutor:
             out = self._outputs.get(key)
         if out is None:
             return None
+
+        def frame_for(cols):
+            from bigslice_tpu.ops.cogroup import Cogroup
+
+            if task.chain and isinstance(task.chain[-1], Cogroup):
+                # Decode the padded device encoding into the Cogroup
+                # contract's ragged object lists (parallel/cogroup.py).
+                from bigslice_tpu.parallel.cogroup import (
+                    ragged_from_padded,
+                )
+
+                cs = task.chain[-1]
+                cols = ragged_from_padded(
+                    cs.prefix,
+                    tuple(len(sl.schema) - sl.prefix
+                          for sl in cs.slices),
+                    cols,
+                )
+            return Frame(cols, task.schema)
+
         shard = task.name.shard
         if isinstance(out, WavedGroupOutput):
             if partition != 0:
@@ -1922,7 +2021,7 @@ class MeshExecutor:
             cols = [c[shard % out.nmesh] for c in chunks]
             if not len(cols[0]):
                 return []
-            return [Frame(cols, task.schema)]
+            return [frame_for(cols)]
         chunks = out.host_chunks()
         if out.partitioned:
             # Post-shuffle: device p holds partition p merged over
@@ -1949,4 +2048,4 @@ class MeshExecutor:
             cols = [c[shard] for c in chunks]
         if not len(cols[0]):
             return []
-        return [Frame(cols, task.schema)]
+        return [frame_for(cols)]

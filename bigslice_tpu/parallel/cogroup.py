@@ -1,0 +1,158 @@
+"""Device lowering of the general Cogroup (round-2 verdict #4).
+
+The reference's cogroup (cogroup.go:46-272) externally sorts each
+input's partition stream and heap-merges ragged groups on the host.
+The TPU lowering replaces the merge with ONE tagged sort over the
+union of all inputs' rows per device (the shuffle already routed equal
+keys to the same partition), then rank-scatters each input's values
+into a fixed-capacity [keys, G] matrix — the SURVEY §7.3(1) pad/count
+encoding, with exact per-(key, input) counts:
+
+    sort (validity, key..., dep) carrying value payloads
+    key heads   → union-key ranks (full outer join of all inputs)
+    pair heads  → per-(key, dep) segment ranks
+    scatter     → per-dep [union_keys, G] matrices + count columns
+
+The capacity G is NOT user-declared (GroupByKey's contract): the mesh
+executor discovers it — the kernel reports the collective max deficit
+``max(0, biggest group - G)`` (pmax across the mesh, so every process
+sees the same number), and the executor's retry ladder re-compiles at
+the grown capacity. One shared G across inputs keeps the deficit a
+single scalar; the cost is padding the smaller input's groups to the
+larger's capacity.
+
+Overflowing rows (only possible mid-ladder, never in a committed
+attempt) drop deterministically from the sorted tail, like
+parallel/groupby.py. Output rows live in the sorted row space —
+union-key heads carry the key, the gathered group matrix per value
+column, and the count per dep — so the executor's generic mask
+compaction and vector-column plumbing apply unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import numpy as np
+
+
+def make_cogroup_align(nk: int, nvals: Tuple[int, ...], capacity: int,
+                       axis: str):
+    """Build the per-device cogroup aligner.
+
+    ``nvals[j]`` is input j's value-column count; ``capacity`` the
+    shared group capacity G. Returns ``fn(masks, col_sets) -> (mask,
+    cols, deficit)`` where ``cols`` is ``[key...,
+    (per input: value matrices [n, G]..., count int32)...]`` in the
+    sorted concat row space, ``mask`` marks union-key head rows, and
+    ``deficit`` is the collective max capacity shortfall (0 = fits).
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    ndeps = len(nvals)
+    G = int(capacity)
+
+    def align(masks: Sequence, col_sets: Sequence):
+        sizes = [cs[0].shape[0] for cs in col_sets]
+        ntot = sum(sizes)
+
+        inval = jnp.concatenate([
+            (~m).astype(np.int32) for m in masks
+        ])
+        keys = [
+            jnp.concatenate([cs[k] for cs in col_sets])
+            for k in range(nk)
+        ]
+        dep = jnp.concatenate([
+            jnp.full((sz,), j, np.int32) for j, sz in enumerate(sizes)
+        ])
+        # Value payloads ride the sort in concat space: input j's
+        # column occupies its segment, zeros elsewhere.
+        payloads = []
+        for j, cs in enumerate(col_sets):
+            for v in range(nvals[j]):
+                col = cs[nk + v]
+                payloads.append(jnp.concatenate([
+                    col if i == j
+                    else jnp.zeros((sizes[i],), col.dtype)
+                    for i in range(ndeps)
+                ]))
+
+        sorted_ops = lax.sort(
+            [inval] + keys + [dep] + payloads,
+            num_keys=nk + 2, is_stable=True,
+        )
+        s_inval = sorted_ops[0]
+        s_keys = sorted_ops[1: 1 + nk]
+        s_dep = sorted_ops[1 + nk]
+        s_pay = sorted_ops[2 + nk:]
+        valid = s_inval == 0
+
+        idx = jnp.arange(ntot, dtype=np.int32)
+        key_diff = jnp.zeros(ntot, bool).at[0].set(True)
+        for k in s_keys:
+            key_diff = key_diff.at[1:].set(
+                key_diff[1:] | (k[1:] != k[:-1])
+            )
+        key_head = valid & key_diff
+        u = jnp.cumsum(key_head.astype(np.int32)) - 1  # union rank
+
+        pair_head = valid & (
+            key_diff | jnp.concatenate([
+                jnp.ones(1, bool), s_dep[1:] != s_dep[:-1]
+            ])
+        )
+        seg_start = lax.associative_scan(
+            jnp.maximum, jnp.where(pair_head, idx, np.int32(-1))
+        )
+        rank = idx - seg_start  # position within the (key, dep) group
+
+        out_cols = list(s_keys)
+        deficit = jnp.int32(0)
+        u_row = jnp.where(valid, u, 0)
+        for j in range(ndeps):
+            sel = valid & (s_dep == j)
+            # Exact per-union-key counts for input j (dump lane ntot).
+            cnt = jnp.zeros(ntot + 1, np.int32).at[
+                jnp.where(sel, u, np.int32(ntot))
+            ].add(1, mode="drop")
+            deficit = jnp.maximum(
+                deficit, jnp.max(cnt[:-1]) - np.int32(G)
+            )
+            # mode="drop" discards both the invalid/foreign rows
+            # (dump row ntot) and rank >= G overflow columns.
+            u_dump = jnp.where(sel, u, np.int32(ntot))
+            for v in range(nvals[j]):
+                pay = s_pay[sum(nvals[:j]) + v]
+                mat = jnp.zeros((ntot + 1, G), pay.dtype).at[
+                    u_dump, rank
+                ].set(pay, mode="drop")
+                # Back to the row space: head row of union key u
+                # carries u's group.
+                out_cols.append(mat[u_row])
+            out_cols.append(cnt[u_row])
+        deficit = jnp.maximum(deficit, 0)
+        deficit = lax.pmax(deficit, axis)
+        return key_head, out_cols, deficit
+
+    return align
+
+
+def ragged_from_padded(nk: int, nvals: Tuple[int, ...], cols):
+    """Host-side decode of the padded encoding into the Cogroup
+    contract's object list columns (counts are exact — a committed
+    attempt never truncates): [keys..., per input per value col:
+    object column of lists]."""
+    out = [np.asarray(c) for c in cols[:nk]]
+    off = nk
+    for nv in nvals:
+        mats = [np.asarray(cols[off + v]) for v in range(nv)]
+        cnt = np.asarray(cols[off + nv])
+        off += nv + 1
+        for m in mats:
+            col = np.empty(len(cnt), dtype=object)
+            for i in range(len(cnt)):
+                col[i] = list(m[i, : cnt[i]])
+            out.append(col)
+    return out

@@ -174,6 +174,24 @@ def worker(num_processes: int, process_id: int, port: int,
     got_dj = {k: (int(a), int(b)) for k, a, b in sess.run(djoin).rows()}
     assert got_dj == join_count_oracle(ak.tolist(), bk.tolist())
 
+    # Device cogroup under SPMD: the tagged-sort group kernel with
+    # capacity discovery (deficit is a cross-process pmax; a hot key
+    # exercises the collective retry identically on every process).
+    cg_keys = np.concatenate([
+        np.zeros(n * 8, np.int32),  # hot key >> default capacity 8
+        rng.randint(1, 5, n * 8).astype(np.int32),
+    ])
+    cg_vals = np.arange(len(cg_keys), dtype=np.int32)
+    cg = bs.Cogroup(bs.Const(n, cg_keys, cg_vals))
+    cg_rows = {int(k): sorted(int(v) for v in g)
+               for k, g in sess.run(cg).rows()}
+    cg_expect: dict = {}
+    for kk, vv in zip(cg_keys.tolist(), cg_vals.tolist()):
+        cg_expect.setdefault(kk, []).append(vv)
+    assert cg_rows == {k: sorted(v) for k, v in cg_expect.items()}
+    assert any("cogroup" in t.op for t in ex._task_index)
+    assert max(ex._cogroup_caps.values()) >= n * 8
+
     # Iterative reuse across runs (Result as input) under SPMD.
     base = sess.run(bs.Const(n, np.arange(n * 8, dtype=np.int32)))
     doubled = sorted(sess.run(bs.Map(base, lambda x: x * 2)).rows())

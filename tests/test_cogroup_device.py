@@ -1,0 +1,134 @@
+"""Device lowering of the general Cogroup (parallel/cogroup.py +
+meshexec's capacity retry ladder): the round-2 verdict #4 gap. The
+host tier (ops/cogroup.py) remains the oracle — and the fallback for
+object columns and fused host consumers."""
+
+import numpy as np
+import pytest
+
+import jax
+
+import bigslice_tpu as bs
+from bigslice_tpu.exec.meshexec import MeshExecutor
+from bigslice_tpu.exec.session import Session
+
+
+@pytest.fixture
+def mesh():
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(jax.devices()[:8]), ("shards",))
+
+
+@pytest.fixture
+def sess(mesh):
+    return Session(executor=MeshExecutor(mesh))
+
+
+def _group_oracle(keys, vals):
+    oracle = {}
+    for k, v in zip(keys.tolist(), vals.tolist()):
+        oracle.setdefault(k, []).append(v)
+    return oracle
+
+
+def test_single_slice_cogroup_engages_mesh(sess):
+    rng = np.random.RandomState(0)
+    keys = rng.randint(0, 40, 1600).astype(np.int32)
+    vals = rng.randint(0, 100, 1600).astype(np.int32)
+    cg = bs.Cogroup(bs.Const(8, keys, vals))
+    rows = list(sess.run(cg).rows())
+    oracle = _group_oracle(keys, vals)
+    assert len(rows) == len(oracle)
+    for k, grouped in rows:
+        assert sorted(int(v) for v in grouped) == sorted(oracle[int(k)])
+    # The cogroup group itself ran on the device path (producer
+    # shuffle group + cogroup group).
+    assert sess.executor.device_group_count() >= 2
+    assert any("cogroup" in t.op
+               for t in sess.executor._task_index), \
+        list(sess.executor._task_index)
+
+
+def test_two_slice_cogroup_matches_host_oracle(sess):
+    """Full outer join with grouped values — keys on either side only
+    must appear with an empty group for the absent side."""
+    rng = np.random.RandomState(1)
+    ak = rng.randint(0, 20, 900).astype(np.int32)
+    av = rng.randint(0, 50, 900).astype(np.int32)
+    bk = rng.randint(10, 30, 700).astype(np.int32)
+    bv = rng.randint(0, 50, 700).astype(np.int32)
+    cg = bs.Cogroup(bs.Const(8, ak, av), bs.Const(8, bk, bv))
+    rows = list(sess.run(cg).rows())
+    oa, ob = _group_oracle(ak, av), _group_oracle(bk, bv)
+    all_keys = set(oa) | set(ob)
+    assert {int(k) for k, _, _ in rows} == all_keys
+    for k, ga, gb in rows:
+        assert sorted(int(v) for v in ga) == sorted(oa.get(int(k), []))
+        assert sorted(int(v) for v in gb) == sorted(ob.get(int(k), []))
+    assert any("cogroup" in t.op for t in sess.executor._task_index)
+
+
+def test_cogroup_hot_key_exercises_capacity_retry(sess):
+    """A hot key far beyond the starting capacity forces the deficit
+    signal and the recompile-at-grown-capacity retry; results stay
+    exact (no truncation in a committed attempt)."""
+    rng = np.random.RandomState(2)
+    keys = np.concatenate([
+        np.zeros(700, np.int32),  # hot key: group size 700 >> 8
+        rng.randint(1, 10, 300).astype(np.int32),
+    ])
+    vals = np.arange(1000, dtype=np.int32)
+    perm = rng.permutation(1000)
+    keys, vals = keys[perm], vals[perm]
+    cg = bs.Cogroup(bs.Const(8, keys, vals))
+    rows = dict(
+        (int(k), sorted(int(v) for v in g))
+        for k, g in sess.run(cg).rows()
+    )
+    oracle = {
+        k: sorted(v) for k, v in _group_oracle(keys, vals).items()
+    }
+    assert rows == oracle
+    caps = sess.executor._cogroup_caps
+    assert caps and max(caps.values()) >= 700, caps
+
+
+def test_cogroup_multi_value_columns(sess):
+    rng = np.random.RandomState(3)
+    k = rng.randint(0, 15, 600).astype(np.int32)
+    v1 = rng.randint(0, 99, 600).astype(np.int32)
+    v2 = rng.rand(600).astype(np.float32)
+    cg = bs.Cogroup(bs.Const(8, k, v1, v2))
+    rows = list(sess.run(cg).rows())
+    o1, o2 = _group_oracle(k, v1), _group_oracle(k, v2)
+    assert len(rows) == len(o1)
+    for kk, g1, g2 in rows:
+        assert sorted(int(x) for x in g1) == sorted(o1[int(kk)])
+        assert sorted(float(x) for x in g2) == \
+            pytest.approx(sorted(o2[int(kk)]))
+
+
+def test_cogroup_object_keys_fall_back_to_host(sess):
+    """Object (string) keys keep the exact host tier — and still work
+    under a mesh session."""
+    words = np.array(["a", "b", "a", "c", "b", "a"], dtype=object)
+    vals = np.arange(6, dtype=np.int32)
+    cg = bs.Cogroup(bs.Const(2, words, vals))
+    rows = {k: sorted(int(v) for v in g)
+            for k, g in sess.run(cg).rows()}
+    assert rows == {"a": [0, 2, 5], "b": [1, 4], "c": [3]}
+
+
+def test_cogroup_fused_host_consumer_falls_back(sess):
+    """A Cogroup fused with a downstream (host) Map runs host-tier —
+    correctness over residency."""
+    rng = np.random.RandomState(4)
+    keys = rng.randint(0, 12, 400).astype(np.int32)
+    vals = rng.randint(0, 9, 400).astype(np.int32)
+    cg = bs.Cogroup(bs.Const(4, keys, vals))
+    m = bs.Map(cg, lambda k, g: (int(k), len(g)),
+               out=[np.int32, np.int32])
+    rows = dict(sess.run(m).rows())
+    oracle = {k: len(v) for k, v in _group_oracle(keys, vals).items()}
+    assert rows == oracle
