@@ -110,6 +110,22 @@ class HostTaskExchange:
         self._published: Set[str] = set()     # base keys with live data
         self._roots: Set[str] = set()         # ever-root base keys (keep)
         self._barrier_seq: Dict[str, int] = {}
+        self._closed = False
+        # Sticky cache of peers' closed tombstones + per-owner check
+        # throttle (the tombstone read is an extra RPC; the condition
+        # can only flip once).
+        self._closed_owners: Set[int] = set()
+        self._closed_checked: Dict[int, float] = {}
+        if self.active:
+            # A previous Session in this same jax.distributed lifetime
+            # left OUR tombstone behind: clear it, or peers of the new
+            # exchange would instantly ERR every task we own.
+            try:
+                self.client.key_value_delete(
+                    f"bigslice/hostdist_closed/{self.pid}"
+                )
+            except Exception:  # noqa: BLE001
+                pass
 
     @property
     def active(self) -> bool:
@@ -145,10 +161,20 @@ class HostTaskExchange:
             return False  # run locally
         if not task.transition_if(TaskState.WAITING, TaskState.RUNNING):
             return True  # another evaluation claimed it
+        base = _base_key(task.name)
+        # A terminal marker left by a DEAD run (abort_run) must not
+        # resolve this fresh attempt: record it as an epoch floor —
+        # only NEWER epochs (the owner's re-publication) count.
+        floor = -1
+        e = self._try_get(f"{base}/e")
+        if e is not None:
+            st = self._try_get(f"{base}/a{int(e)}/state") or ""
+            if st.startswith("err:run aborted"):
+                floor = int(e)
         with self._lock:
             self.remote_count += 1
-            self._pending[_base_key(task.name)] = (
-                task, owner, time.monotonic()
+            self._pending[base] = (
+                task, owner, time.monotonic(), floor
             )
             if self._poller is None:
                 self._poller = threading.Thread(
@@ -189,6 +215,11 @@ class HostTaskExchange:
 
         base = _base_key(task.name)
         with self._lock:
+            if self._closed:
+                # A straggling completion (redundant claim-race run,
+                # late fallback thread) after session teardown must
+                # not resurrect deleted namespaces.
+                return
             epoch = self._epoch.get(base, -1) + 1
             self._epoch[base] = epoch
         ns = f"{base}/a{epoch}"
@@ -225,11 +256,13 @@ class HostTaskExchange:
 
     # -- non-owner side ----------------------------------------------------
 
-    def _resolve_state(self, base: str) -> Optional[str]:
+    def _resolve_state(self, base: str,
+                       floor: int = -1) -> Optional[str]:
         """The owner's latest state for ``base``, or None if not yet
-        published."""
+        published (epochs at or below ``floor`` — a dead run's abort
+        markers — count as unpublished)."""
         e = self._try_get(f"{base}/e")
-        if e is None:
+        if e is None or int(e) <= floor:
             return None
         return self._try_get(f"{base}/a{int(e)}/state")
 
@@ -252,8 +285,8 @@ class HostTaskExchange:
                 continue
             lost = {p for p, _ in (self.keepalive.lost_peers()
                                    if self.keepalive else [])}
-            for key, (task, owner, t0) in items:
-                state = self._resolve_state(key)
+            for key, (task, owner, t0, floor) in items:
+                state = self._resolve_state(key, floor)
                 if state is not None:
                     with self._lock:
                         self._pending.pop(key, None)
@@ -267,6 +300,20 @@ class HostTaskExchange:
                                 f"{owner}: {state[4:]}"
                             ),
                         )
+                elif self._owner_closed(owner):
+                    # The owner shut its session down (deleting its
+                    # published outputs): it will never publish again.
+                    # Resolve as an error instead of trusting its
+                    # (healthy) keepalive forever.
+                    with self._lock:
+                        self._pending.pop(key, None)
+                    task.set_state(
+                        TaskState.ERR,
+                        RuntimeError(
+                            f"host task {task.name} unresolvable: "
+                            f"owner process {owner} closed its session"
+                        ),
+                    )
                 elif owner in lost:
                     with self._lock:
                         self._pending.pop(key, None)
@@ -281,7 +328,7 @@ class HostTaskExchange:
                         with self._lock:
                             if key in self._pending:
                                 self._pending[key] = (
-                                    task, owner, time.monotonic()
+                                    task, owner, time.monotonic(), floor
                                 )
                         continue
                     with self._lock:
@@ -314,9 +361,13 @@ class HostTaskExchange:
             if e is not None:
                 ns = f"{base}/a{int(e)}"
                 if self._try_get(f"{ns}/state") != "ok":
-                    # Failed remotely (no data coming) or a pre-data
-                    # pointer is impossible by construction; treat a
-                    # non-ok state as unpublished.
+                    if self._try_get(f"{base}/e") != e:
+                        # The owner republished and GC'd this epoch
+                        # between our pointer and state reads: retry
+                        # on the new epoch.
+                        continue
+                    # Stable non-ok: failed remotely (no data coming);
+                    # a pre-data pointer is impossible by construction.
                     return None
                 n = self._try_get(f"{ns}/p{partition}/n")
                 chunks = [] if n is None else [
@@ -342,6 +393,30 @@ class HostTaskExchange:
             f, off = codec.decode_frame(blob, off)
             frames.append(f)
         return frames
+
+    def abort_run(self, roots: List[Task], err) -> None:
+        """The local evaluation died (TaskError / classified gang
+        loss): publish a terminal abort epoch for every OWNED,
+        distributable, host-tier, non-OK task of the run so non-owner
+        waiters resolve to ERR instead of trusting the (healthy)
+        owner's keepalive forever — the owner is alive; its RUN is
+        what died. A later attempt ignores these markers (epoch floor
+        in submit) and waits for the owner's re-publication."""
+        if not self.active:
+            return
+        from bigslice_tpu.exec.task import iter_tasks
+
+        eligible = getattr(self.executor, "_eligible", None)
+        for t in iter_tasks(roots):
+            if (self.owner_of(t) != self.pid
+                    or not self.distributable(t)
+                    or t.state == TaskState.OK):
+                continue
+            if eligible is not None and eligible(t):
+                continue  # device-tier: never owner-routed
+            self._try_publish_epoch(
+                t, f"err:run aborted on owner: {err!r}"
+            )
 
     # -- KV hygiene --------------------------------------------------------
 
@@ -385,14 +460,47 @@ class HostTaskExchange:
                 self._published.discard(base)
                 self._epoch.pop(base, None)
 
+    def _owner_closed(self, owner: int) -> bool:
+        """Sticky, throttled tombstone check: at most one RPC per owner
+        per 2s window (positives cached forever — closed cannot
+        un-close within an exchange; a NEW exchange deletes its own
+        stale tombstone at construction)."""
+        if owner in self._closed_owners:
+            return True
+        now = time.monotonic()
+        if now - self._closed_checked.get(owner, 0.0) < 2.0:
+            return False
+        self._closed_checked[owner] = now
+        try:
+            closed = self.client.key_value_try_get(
+                f"bigslice/hostdist_closed/{owner}"
+            ) is not None
+        except Exception:  # noqa: BLE001 — not present
+            return False
+        if closed:
+            self._closed_owners.add(owner)
+        return closed
+
     def close(self) -> None:
-        """Delete everything this process published (session teardown)."""
+        """Delete everything this process published (session teardown).
+        A tombstone under a SEPARATE prefix tells peers still waiting
+        on this owner to resolve (bounded) instead of hanging on a
+        healthy keepalive; callers should quiesce peers (finish their
+        runs/scans) before shutting a session down."""
         if not self.active:
             return
         with self._lock:
+            self._closed = True
             doomed = sorted(self._published)
             self._published.clear()
             self._epoch.clear()
+        try:
+            self.client.key_value_set(
+                f"bigslice/hostdist_closed/{self.pid}", "1",
+                allow_overwrite=True,
+            )
+        except Exception:  # noqa: BLE001 — service going down
+            pass
         for base in doomed:
             self._delete_ns(f"{base}/")
 

@@ -191,6 +191,32 @@ class LocalExecutor:
                         (dep.combine_key, dep.partition)
                     )
                 if not committed:
+                    # No shared buffers. If the producer group rode
+                    # the DEVICE path (machine-combined groups are
+                    # mesh-eligible with device combiners), its real
+                    # per-task outputs are readable through the store
+                    # bridge — confirmed by actual device residency,
+                    # because local-mc producers commit EMPTY store
+                    # entries by design (reading those would silently
+                    # drop data after a discard). Anything else is a
+                    # lost dep: the evaluator re-runs the producers.
+                    owner = getattr(self.store, "owner", None)
+                    if owner is not None and all(
+                        owner._has_device_output(t.name)
+                        for t in dep.tasks
+                    ):
+                        def gen():
+                            for t in dep.tasks:
+                                try:
+                                    yield from self.store.read(
+                                        t.name, dep.partition
+                                    )
+                                except store_mod.Missing as e:
+                                    raise DepLost(
+                                        t, all_producers=dep.tasks
+                                    ) from e
+
+                        return gen()
                     raise DepLost(dep.tasks[0], all_producers=dep.tasks)
                 if frame is None or not len(frame):
                     return sliceio.empty_reader()

@@ -313,9 +313,26 @@ class MeshExecutor:
 
     def __init__(self, mesh, fallback_procs: Optional[int] = None,
                  ordered_dispatch: bool = False, spmd: bool = False,
-                 auto_dense: bool = True):
+                 auto_dense: bool = True,
+                 device_budget_bytes: Optional[int] = None):
+        import os
+
         self.mesh = mesh
         self.nmesh = int(mesh.devices.size)
+        # Per-device working-set budget for one compiled group program
+        # (HBM-overflow splitting, round-2 verdict #6): a wave whose
+        # estimated buffers exceed it runs as K row-slices whose
+        # partitioned sub-outputs merge as multiple producer
+        # contributions — the TPU analog of the combiner's disk spill
+        # (exec/combiner.go:227-305; SURVEY §7.1 host-offload mapping).
+        # None = unlimited (estimation is coarse; the skew/slack ladder
+        # still bounds single-destination blowup).
+        if device_budget_bytes is None:
+            env = os.environ.get("BIGSLICE_DEVICE_BUDGET_BYTES")
+            device_budget_bytes = int(env) if env else None
+        self.device_budget_bytes = device_budget_bytes
+        # op base -> K of the last split run (observability/tests).
+        self.split_runs: Dict[str, int] = {}
         # Automatic dense-key discovery (staging-time min/max probe →
         # table+collective lowering without a dense_keys= annotation).
         # Off for A/B benchmarks of the generic sort path.
@@ -356,6 +373,13 @@ class MeshExecutor:
         # under SPMD it would diverge eligibility across processes and
         # deadlock the gang; there, infra failures are program-level).
         self._probation: Dict[str, float] = {}
+        # SPMD probation is STATE-keyed, not clock-keyed: set when an
+        # infra-classified failure surfaces from a collective program
+        # (symmetric on every process — an asymmetric failure wedges
+        # the gang and takes the keepalive → elastic path instead) and
+        # cleared by resize (also symmetric). Ops here run the host
+        # tier until the mesh changes.
+        self._spmd_probation: set = set()
         # Keepalive over the coordination service (SPMD multi-process):
         # a wedged peer is detected BEFORE this process enters a
         # collective that would hang forever (utils.distributed.
@@ -593,6 +617,12 @@ class MeshExecutor:
         if self._hostdist is not None:
             self._hostdist.release_run(roots)
 
+    def abort_run_outputs(self, roots: List[Task], err) -> None:
+        """Failed-run liveness for distributed host tasks (see
+        hostdist.abort_run). No-op without a live exchange."""
+        if self._hostdist is not None:
+            self._hostdist.abort_run(roots, err)
+
     def close(self) -> None:
         """Session teardown: delete this process's published host-task
         outputs from the coordination service."""
@@ -716,6 +746,7 @@ class MeshExecutor:
             self._programs.clear()
             self._slack_memo.clear()
             self._probation.clear()
+            self._spmd_probation.clear()  # fresh chance on the new mesh
             self.mesh = mesh
             self.nmesh = int(mesh.devices.size)
             self.multiprocess = shuffle_mod.is_multiprocess_mesh(mesh)
@@ -751,6 +782,9 @@ class MeshExecutor:
             if _time.monotonic() < until:
                 return False  # device path on probation for this op
             self._probation.pop(_op_base(task.name.op), None)
+        if (self.multiprocess
+                and _op_base(task.name.op) in self._spmd_probation):
+            return False  # state-keyed SPMD probation (until resize)
         from bigslice_tpu.ops.cogroup import Cogroup
 
         if isinstance(task.chain[-1], Cogroup):
@@ -781,11 +815,15 @@ class MeshExecutor:
             # gathers and trailing-dim bucket scatters.
             return False
         part = task.partitioner
-        if part.combine_key or any(d.combine_key for d in task.deps):
-            # Machine-combined groups coordinate through the local
-            # executor's shared process buffers; the device path has its
-            # own (inherent) per-device combining, so these run fallback.
-            return False
+        # Machine-combined (combine_key) groups RIDE the device path
+        # when their combiner is device-capable: per-device map-side
+        # combining plus the cross-wave re-combine in _merge_outputs is
+        # the mesh analog of the shared per-machine buffer
+        # (exec/bigmachine.go:1084-1210). Host-combiner groups keep the
+        # local shared-buffer tier; mixed tiers bridge via
+        # _dep_input's committed-buffer read and local._dep_factory's
+        # store fallback. (The device-combiner requirement is enforced
+        # by the generic partitioner check below.)
         if task.num_partition > 1:
             from bigslice_tpu.ops.reshuffle import RowPartitioner
 
@@ -1026,6 +1064,36 @@ class MeshExecutor:
                     f"process (Cache/store short-circuits recompute); "
                     f"cause: {e!r}"
                 )
+            elif self.multiprocess and _looks_like_infra_error(e):
+                # State-keyed SPMD probation: a collective program's
+                # infra failure surfaces symmetrically on every
+                # process, so each adds the same op and resubmission
+                # routes to the host tier everywhere — graceful
+                # degradation instead of failing the run. (A failure
+                # only ONE process sees wedges the gang; the keepalive
+                # converts that to HostLostError → elastic, whose
+                # resize clears this set.)
+                self._spmd_probation.add(_op_base(tasks[0].name.op))
+                # The host-tier resubmission reads this group's dep
+                # outputs through the store bridge; they were likely
+                # device-only under consumer-driven gather. We are on
+                # the dispatcher thread at the same plan position on
+                # every process, so the collective gather is safe and
+                # ordered here. Best-effort: if the mesh is too sick,
+                # the Missing → DepLost → host-re-run ladder (bounded
+                # by the consecutive-loss cap) still applies.
+                try:
+                    for d in tasks[0].deps:
+                        pkey = d.tasks[0].group_key
+                        with self._lock:
+                            pout = self._outputs.get(pkey)
+                        if pout is not None and not pout.gathered:
+                            pout.gather()
+                except Exception:  # noqa: BLE001
+                    pass
+                for t in claimed:
+                    t.mark_lost(e)
+                return
             elif not self.multiprocess and _looks_like_infra_error(e):
                 # Machine-loss class: put the op's device path on
                 # probation (exec/slicemachine.go probation analog) and
@@ -1085,6 +1153,126 @@ class MeshExecutor:
         task0 = tasks[0]
         inputs = self._group_inputs(tasks, wave)
         self._maybe_auto_dense(task0, inputs, wave)
+        budget = self.device_budget_bytes
+        if (budget
+                and task0.num_partition > 1
+                and len(inputs) == 1 and not inputs[0][3]
+                and self._splittable_chain(task0)
+                and self._wave_bytes_estimate(task0, inputs) > budget):
+            split = self._try_execute_wave_split(
+                tasks, wave, inputs, budget
+            )
+            if split is not None:
+                return split
+        return self._execute_wave_on(tasks, wave, inputs)
+
+    def _splittable_chain(self, task0: Task) -> bool:
+        """Row-slicing a shard is only sound for chains whose stages
+        are ROW-LOCAL up to the final shuffle: map/filter/flatmap
+        transform each row independently, and the shuffle's map-side
+        combiner may emit per-slice partials because its CONSUMER
+        group re-combines contributions by contract. Rank/group-
+        sensitive stages (Head's per-shard n, Fold/Reduce/GroupBy as
+        mid-chain stages, joins) would compute per-slice answers that
+        no consumer reconciles — those waves run unsplit."""
+        stages = self._stages_for(task0)
+        if not stages or stages[-1][0] != "shuffle":
+            return False
+        return all(k in ("map", "filter", "flatmap")
+                   for k, _, _ in stages[:-1])
+
+    def _wave_bytes_estimate(self, task0: Task, inputs) -> int:
+        """Coarse per-device working-set model for one compiled wave:
+        input rows × row bytes × (sort operands + scratch + the
+        slack-scaled receive buffer). Precision doesn't matter — the
+        estimate only picks WHEN to split and HOW MANY slices."""
+        rows = sum(i[2] for i in inputs)
+        rowbytes = sum(
+            np.dtype(c.dtype).itemsize
+            for i in inputs for c in i[0]
+        ) or 4
+        slack = self._slack_memo.get(_op_base(task0.name.op), 2.0)
+        fanout = 1
+        for st in self._stages_for(task0):
+            if st[0] == "flatmap":
+                fanout *= st[2].fanout
+        return int(rows * fanout * rowbytes * (3 + slack))
+
+    def _try_execute_wave_split(self, tasks: List[Task], wave: int,
+                                inputs, budget: int):
+        """Run the wave as K row-slices of its single dep, each under
+        the budget, merging the partitioned sub-outputs as multiple
+        producer contributions (consumers re-combine/concat per their
+        semantics — exactly the wave-merge contract). Returns None when
+        the shape doesn't split cleanly (power-of-two capacities make
+        that the rare case)."""
+        task0 = tasks[0]
+        cols, counts, cap, _sub = inputs[0]
+        est = self._wave_bytes_estimate(task0, inputs)
+        want = (est + budget - 1) // budget
+        K = 1
+        while K < want:
+            K <<= 1
+        K = min(K, cap)
+        while K > 1 and cap % K:
+            K >>= 1  # only exact row-slices keep the prefix contract
+        if K <= 1:
+            return None
+        B = cap // K
+        prog = self._slice_wave_program(
+            tuple(str(np.dtype(c.dtype)) for c in cols), cap, B
+        )
+        outs = []
+        for b in range(K):
+            sub_counts, sub_cols = prog(np.int32(b), counts, *cols)
+            outs.append(self._execute_wave_on(
+                tasks, wave, [(list(sub_cols), sub_counts, B, False)]
+            ))
+        self.split_runs[_op_base(task0.name.op)] = K
+        return self._merge_outputs(outs, task0)
+
+    def _slice_wave_program(self, dtypes: Tuple[str, ...], cap: int,
+                            B: int):
+        """Compiled per-device row-slicer: batch b is rows
+        [b*B, (b+1)*B) of each device's capacity window, with the
+        valid-prefix count clipped into the slice."""
+        key = ("rowslice", dtypes, cap, B)
+        with self._lock:
+            cached = self._programs.get(key)
+        if cached is not None:
+            return cached[0]
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        axis = mesh_axis(self.mesh)
+        shard_map = get_shard_map()
+        ncols = len(dtypes)
+
+        def stepped(b, counts, *cols):
+            start = b * B
+            sub = tuple(
+                lax.dynamic_slice_in_dim(c, start, B) for c in cols
+            )
+            subn = jnp.clip(counts[0] - start, 0, B).astype(np.int32)
+            return subn.reshape(1), sub
+
+        prog = jax.jit(shard_map(
+            stepped, mesh=self.mesh,
+            in_specs=(P(), P(axis)) + tuple(P(axis) for _ in range(ncols)),
+            out_specs=(P(axis), tuple(P(axis) for _ in range(ncols))),
+            check_rep=False,
+        ))
+        with self._lock:
+            self._programs[key] = (prog, ())
+            while len(self._programs) > _PROGRAM_CACHE_MAX:
+                self._programs.pop(next(iter(self._programs)))
+        return prog
+
+    def _execute_wave_on(self, tasks: List[Task], wave: int,
+                         inputs) -> DeviceGroupOutput:
+        task0 = tasks[0]
         caps = tuple(i[2] for i in inputs)
         counts_list = [i[1] for i in inputs]
         cols_flat = [c for i in inputs for c in i[0]]
@@ -1205,7 +1393,13 @@ class MeshExecutor:
         concat + recompact program (O(W·cap) data movement, one
         compilation per (shape, W)). Consumers treat the merged rows as
         multiple producer contributions — combiner-bearing consumers
-        re-combine, concat consumers concat."""
+        re-combine, concat consumers concat.
+
+        Machine-combined producers (combine_key with a device combiner)
+        additionally RE-COMBINE across waves here — the mesh analog of
+        the reference's shared per-machine combiner buffer
+        (exec/bigmachine.go:1084-1210): each device's merged partition
+        holds at most one row per key before any consumer reads it."""
         if len(outs) == 1:
             return outs[0]
         # Wave-partitioned outputs carry a leading subid column beyond
@@ -1215,7 +1409,16 @@ class MeshExecutor:
                   + tuple(str(ct.dtype) for ct in task0.schema))
         caps = tuple(o.capacity for o in outs)
         W = len(outs)
-        key = ("merge", ncols, caps, dtypes)
+        fc = task0.partitioner.combiner
+        mc = (task0.partitioner.combine_key
+              and fc is not None and getattr(fc, "device", False)
+              # Scalar columns only: the segmented re-combine sorts
+              # value operands.
+              and all(ct.shape == () for ct in task0.schema))
+        has_subid = outs[0].subid
+        key = ("merge", ncols, caps, dtypes,
+               (id(fc.fn), fc.nkeys, fc.nvals, has_subid)
+               if mc else None)
         with self._lock:
             cached = self._programs.get(key)
         if cached is not None:
@@ -1240,6 +1443,19 @@ class MeshExecutor:
                                      for w in range(W)])
                     for j in range(ncols)
                 ]
+                if mc:
+                    # Cross-wave machine re-combine: the subid (when
+                    # present) rides as an extra leading key so rows
+                    # of different wave-partitions never merge.
+                    nk = fc.nkeys + (1 if has_subid else 0)
+                    core = segment.make_segmented_reduce_masked(
+                        nk, fc.nvals,
+                        segment.canonical_combine(fc.fn, fc.nvals),
+                    )
+                    mask, keys, vals = core(
+                        mask, tuple(merged[:nk]), tuple(merged[nk:])
+                    )
+                    merged = list(keys) + list(vals)
                 n, packed = segment.compact_by_mask(mask, merged)
                 return n.reshape(1), tuple(packed)
 
@@ -1313,6 +1529,33 @@ class MeshExecutor:
             # Aligned (materialize-boundary) dep, device-resident:
             # device s holds producer shard s == consumer shard s.
             return out.cols, out.counts, out.capacity, False
+        if dep0.combine_key:
+            # Machine-combined dep whose producers ran the LOCAL
+            # shared-buffer tier: per-task store entries are empty by
+            # design (exec/local.py _machine_combine), so read the
+            # committed machine buffer and upload. Uncommitted means
+            # the producers ran the device path instead — fall through
+            # to per-task store reads (bridged to mesh outputs).
+            with self.local._mc_lock:
+                committed = (dep0.combine_key
+                             in self.local._mc_keys_committed)
+                bufs = {}
+                if committed:
+                    for t in tasks:
+                        p = t.deps[dep_idx].partition
+                        bufs[p] = self.local._mc_committed.get(
+                            (dep0.combine_key, p)
+                        )
+            if committed:
+                schema = dep0.tasks[0].schema
+                per_shard = []
+                for t in tasks:
+                    f = bufs.get(t.deps[dep_idx].partition)
+                    per_shard.append(
+                        f.to_host() if f is not None and len(f)
+                        else Frame.empty(schema)
+                    )
+                return self._upload(per_shard)
         # Fallback-produced dep: load frames from the store per shard.
         per_shard_frames = []
         for t in tasks:

@@ -315,6 +315,15 @@ class Session:
                     finish = getattr(self.executor, "finish_run", None)
                     if finish is not None:
                         finish(token=run_token, failed=err is not None)
+                    if err is not None:
+                        # Dead-run liveness: resolve remote waiters on
+                        # this process's owned host tasks (the owner is
+                        # healthy — its run is what died).
+                        abort = getattr(
+                            self.executor, "abort_run_outputs", None
+                        )
+                        if abort is not None:
+                            abort(tasks, err)
                 if err is None:
                     # KV hygiene for distributed host tasks: peers have
                     # all finished this run (barrier inside), so the

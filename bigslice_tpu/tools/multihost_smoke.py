@@ -287,14 +287,64 @@ def worker(num_processes: int, process_id: int, port: int,
     assert all("reduce" in k[0] if isinstance(k, tuple) else "reduce" in k
                for k in left), left
 
+    # 5. State-keyed SPMD probation (round-2 verdict #7b): an
+    # infra-classified failure raised from a collective program
+    # (injected symmetrically — both processes run this same code, so
+    # both inject) puts the op on probation; resubmission routes to the
+    # host tier on every process and the run SUCCEEDS without an
+    # elastic restart. The device-resident producer becomes readable
+    # through the retriable Missing → DepLost → host-re-run ladder.
+    from bigslice_tpu.exec import meshexec as meshexec_mod
+
+    orig_exec = meshexec_mod.MeshExecutor._execute_group_inner
+    armed = {"n": 0}
+
+    def failing_exec(self, gkey, gtasks):
+        if (any("reduce" in t.name.op for t in gtasks)
+                and "#" in gtasks[0].name.op and armed["n"] == 0):
+            armed["n"] = 1
+            raise RuntimeError(
+                "injected device failure: RESOURCE_EXHAUSTED out of "
+                "memory while allocating scratch"
+            )
+        return orig_exec(self, gkey, gtasks)
+
+    meshexec_mod.MeshExecutor._execute_group_inner = failing_exec
+    try:
+        pk = rng.randint(0, 11, n * 24).astype(np.int32)
+        pred = bs.Reduce(bs.Const(n, pk, np.ones(len(pk), np.int32)),
+                         add)
+        got_p = dict(sess.run(pred).rows())
+    finally:
+        meshexec_mod.MeshExecutor._execute_group_inner = orig_exec
+    expect_p: dict = {}
+    for kk in pk.tolist():
+        expect_p[kk] = expect_p.get(kk, 0) + 1
+    assert got_p == expect_p, (got_p, expect_p)
+    assert armed["n"] == 1  # the failure actually fired
+    assert ex._spmd_probation, "op should be on state-keyed probation"
+
     # Teardown deletes this process's remaining published namespaces;
     # after both sides close, the KV prefix is empty (no landfill).
+    # Quiesce first: a peer may still be lazily fetching this process's
+    # published roots for ITS result scans — closing early would delete
+    # them mid-read (the tombstone bounds that to an error, but the
+    # clean protocol is barrier → close → barrier → check).
+    import time
+
     groups = sess.executor.device_group_count()
-    sess.shutdown()
     try:
-        hd.client.wait_at_barrier("bigslice_hostdist_smoke_done", 30_000)
+        hd.client.wait_at_barrier("bigslice_hostdist_quiesce", 60_000)
     except Exception:  # noqa: BLE001
         pass
+    sess.shutdown()
+    try:
+        hd.client.wait_at_barrier("bigslice_hostdist_smoke_done", 60_000)
+    except Exception:  # noqa: BLE001
+        pass
+    deadline = time.time() + 10.0
+    while _hd_keys() and time.time() < deadline:
+        time.sleep(0.2)
     assert not _hd_keys(), _hd_keys()
 
     if process_id == 0:

@@ -108,6 +108,9 @@ def make_exchange(nprocs=2, pid=0, keepalive=None, store=None):
     ex._published = set()
     ex._roots = set()
     ex._barrier_seq = {}
+    ex._closed = False
+    ex._closed_owners = set()
+    ex._closed_checked = {}
     return ex
 
 
@@ -261,7 +264,12 @@ def test_release_run_keeps_roots_deletes_intermediates():
     assert any(_base_key(root.name) in k for k in keys), keys
 
     ex.close()
-    assert not ex.client.kv, ex.client.kv
+    # Only the closed tombstone (separate prefix — bounds peers still
+    # waiting on this owner) survives.
+    left = [k for k in ex.client.kv
+            if k.startswith("bigslice/hostdist/")]
+    assert not left, left
+    assert "bigslice/hostdist_closed/0" in ex.client.kv
 
 
 def test_distributable_excludes_machine_combined():

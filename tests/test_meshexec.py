@@ -717,3 +717,92 @@ def test_plan_gather_marks_and_pays_late_debt(mesh):
     ex.plan_gather(roots, token="t2")
     assert "P2" not in ex._gather_marked     # device-chained, stays put
     assert "C2" in ex._gather_marked         # feeds the host-tier root
+
+
+def test_machine_combiners_ride_device_path(mesh):
+    """combine_key groups with device combiners are mesh-eligible
+    (round-2 verdict #7a): correctness matches, and the groups actually
+    engage the device instead of the forced fallback of round 2."""
+    sess = Session(executor=MeshExecutor(mesh), machine_combiners=True)
+    rng = np.random.RandomState(11)
+    keys = rng.randint(0, 60, 1600).astype(np.int32)
+    vals = rng.randint(0, 10, 1600).astype(np.int32)
+    r = bs.Reduce(bs.Const(8, keys, vals), lambda a, b: a + b)
+    got = dict(sess.run(r).rows())
+    oracle = {}
+    for k, v in zip(keys.tolist(), vals.tolist()):
+        oracle[k] = oracle.get(k, 0) + v
+    assert got == oracle
+    assert sess.executor.device_group_count() >= 2
+    # The local machine-combiner buffers were never engaged.
+    assert not sess.executor.local._mc_keys_committed
+
+
+def test_machine_combiners_waved_cross_wave_recombine(mesh):
+    """S > N machine-combined producers re-combine across waves in
+    _merge_outputs (the shared per-machine buffer analog): the merged
+    partition holds at most one row per (subid, key) before consumers
+    read it."""
+    sess = Session(executor=MeshExecutor(mesh), machine_combiners=True)
+    rng = np.random.RandomState(12)
+    nsh = 16  # 2 waves on the 8-device mesh
+    keys = rng.randint(0, 30, 3200).astype(np.int32)
+    vals = np.ones(3200, np.int32)
+    r = bs.Reduce(bs.Const(nsh, keys, vals), lambda a, b: a + b)
+    got = dict(sess.run(r).rows())
+    oracle = {}
+    for k in keys.tolist():
+        oracle[k] = oracle.get(k, 0) + 1
+    assert got == oracle
+    # The producer group's merged output was re-combined: per device,
+    # at most one row per (subid, key).
+    ex = sess.executor
+    with ex._lock:
+        merged = [o for o in ex._outputs.values()
+                  if getattr(o, "partitioned", False)]
+    assert merged
+    for out in merged:
+        chunks = out.host_chunks()
+        for d in range(out.nmesh):
+            cols = [np.asarray(c[d]) for c in chunks]
+            if not len(cols[0]):
+                continue
+            pairs = list(zip(*[c.tolist() for c in
+                               cols[:2 if out.subid else 1]]))
+            assert len(pairs) == len(set(pairs)), \
+                "duplicate (subid, key) rows survived the re-combine"
+
+
+def test_hbm_budget_splits_wave(mesh):
+    """A wave whose estimated working set exceeds the per-device budget
+    runs as K row-slices (round-2 verdict #6): results are exact, the
+    compiled sub-programs see bounded capacities, and the partitioned
+    sub-outputs merge as multiple producer contributions."""
+    tiny = 2_000  # bytes — far below any real wave
+    sess = Session(executor=MeshExecutor(mesh,
+                                         device_budget_bytes=tiny))
+    rng = np.random.RandomState(13)
+    keys = rng.randint(0, 50, 4096).astype(np.int32)
+    vals = rng.randint(0, 7, 4096).astype(np.int32)
+    r = bs.Reduce(bs.Const(8, keys, vals), lambda a, b: a + b)
+    got = dict(sess.run(r).rows())
+    oracle = {}
+    for k, v in zip(keys.tolist(), vals.tolist()):
+        oracle[k] = oracle.get(k, 0) + v
+    assert got == oracle
+    ex = sess.executor
+    assert ex.split_runs, "the split path should have engaged"
+    K = max(ex.split_runs.values())
+    assert K > 1
+    # Peak compiled capacity is bounded: every sub-run's input slice is
+    # cap/K rows (the slicer programs record the B actually used).
+    bs_used = [k[3] for k in ex._programs if k[0] == "rowslice"]
+    assert bs_used and all(b * K <= 4096 for b in bs_used)
+
+    # Unbudgeted baseline agrees.
+    base = dict(
+        Session(executor=MeshExecutor(mesh)).run(
+            bs.Reduce(bs.Const(8, keys, vals), lambda a, b: a + b)
+        ).rows()
+    )
+    assert base == oracle
