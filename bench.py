@@ -444,6 +444,25 @@ def kmeans_bench(n_points: int, d: int, k: int, rounds: int = 3,
 
 # ------------------------------------------------------------- attention
 
+# Advertised peak bf16 TFLOP/s per chip by device kind (public specs;
+# substring-matched against jax's device_kind). MFU = model FLOP/s ÷
+# (per-chip peak × chips).
+_PEAK_TFLOPS = (
+    ("v6", 918.0), ("v5p", 459.0), ("v5e", 197.0),
+    ("v5", 197.0), ("v4", 275.0), ("v3", 123.0), ("v2", 45.0),
+)
+
+
+def _mesh_peak_tflops(mesh):
+    kind = str(
+        getattr(mesh.devices.flat[0], "device_kind", "")
+    ).lower()
+    for tag, peak in _PEAK_TFLOPS:
+        if tag in kind:
+            return peak * mesh.devices.size
+    return None
+
+
 def attention_bench(seq: int, h: int, d: int, iters: int = 5):
     """Beyond-reference long-context mode: ring vs Ulysses sequence-
     parallel attention over the mesh, reported as model TFLOP/s
@@ -477,13 +496,29 @@ def attention_bench(seq: int, h: int, d: int, iters: int = 5):
 
     u_fn = ul.make_ulysses_attention(mesh, nheads=h, d=d, causal=True)
     t_u = time_fn(u_fn, qg, kg, vg)
-    note(f"attention ulysses: {flops/t_u/1e12:.3f} TFLOP/s "
+    note(f"attention ulysses fp32: {flops/t_u/1e12:.3f} TFLOP/s "
          f"(seq={seq}, h={h}, d={d})")
-    r_fn = ra.make_ring_attention(mesh, d=d, causal=True)
+    import jax.numpy as jnp
+
+    ub_fn = ul.make_ulysses_attention(mesh, nheads=h, d=d, causal=True,
+                                      dtype=jnp.bfloat16)
+    t_ub = time_fn(ub_fn, qg, kg, vg)
+    note(f"attention ulysses bf16: {flops/t_ub/1e12:.3f} TFLOP/s")
+    r_fn = ra.make_ring_attention(mesh, d=d, causal=True,
+                                  dtype=jnp.bfloat16,
+                                  block_q=max(128, seq // 64))
     h0 = (jax.device_put(x[:, 0], sharding) for x in (q, k, v))
     t_r = time_fn(r_fn, *h0) * h  # one head timed; scale to h heads
-    note(f"attention ring: {flops/t_r/1e12:.3f} TFLOP/s "
+    note(f"attention ring bf16 blocked: {flops/t_r/1e12:.3f} TFLOP/s "
          f"(per-head timing × {h})")
+    t_u = min(t_u, t_ub)
+    peak = _mesh_peak_tflops(mesh)
+    if peak:
+        mfu = flops / min(t_u, t_r) / 1e12 / peak
+        note(f"attention MFU: {100 * mfu:.1f}% of {peak:.0f} TFLOP/s "
+             f"mesh peak")
+    else:
+        note("attention MFU: n/a (unknown device peak — CPU fallback)")
 
     # CPU baseline: the dense float64 oracle on one head of a REDUCED
     # sequence (the [seq, seq] temporaries are O(seq²·8B) — at
@@ -639,7 +674,7 @@ def run_mode(mode: str, size, fallback: bool) -> None:
 # driver parses the tail JSON line (VERDICT r2 #1). Fast sizes so the
 # full sweep stays bounded even on the 1-vCPU fallback.
 MATRIX = ("reduce-sort", "reduce-dense", "join", "join-dense",
-          "wordcount", "sortshuffle", "kmeans", "reduce")
+          "wordcount", "sortshuffle", "kmeans", "attention", "reduce")
 
 # Fast matrix sizes per mode (None → the mode's own fallback default).
 _MATRIX_SIZES = {
@@ -651,6 +686,7 @@ _MATRIX_SIZES = {
     "wordcount": 1 << 17,
     "sortshuffle": 1 << 19,
     "kmeans": 1 << 12,
+    "attention": 1 << 10,
 }
 
 

@@ -46,6 +46,7 @@ from bigslice_tpu.ops.fold import Fold
 from bigslice_tpu.ops.cogroup import Cogroup
 from bigslice_tpu.ops.join import JoinAggregate
 from bigslice_tpu.ops.groupby import GroupByKey
+from bigslice_tpu.ops.attention import SelfAttend
 from bigslice_tpu.ops.reshuffle import Reshuffle, Repartition, Reshard
 from bigslice_tpu.ops.cache import Cache, CachePartial, ReadCache
 
@@ -79,6 +80,7 @@ __all__ = [
     "Cogroup",
     "JoinAggregate",
     "GroupByKey",
+    "SelfAttend",
     "Reshuffle",
     "Repartition",
     "Reshard",

@@ -38,7 +38,7 @@ def pipeline(slice_: Slice) -> List[Slice]:
         if len(deps) != 1:
             return out
         dep = deps[0]
-        if dep.shuffle:
+        if dep.shuffle or dep.broadcast:
             return out
         if dep.slice.materialize:
             return out
@@ -172,6 +172,11 @@ class Compiler:
                             combine_key=dep_part.combine_key,
                         )
                     )
+                elif dep.broadcast:
+                    # Broadcast read: every shard reads EVERY producer
+                    # task's partition 0 — the full dataset (globally-
+                    # coupled host tiers, e.g. SelfAttend).
+                    deps.append(TaskDep(tuple(dep_tasks), 0))
                 else:
                     # Aligned read: shard i reads dep shard i's partition 0.
                     deps.append(TaskDep((dep_tasks[shard],), 0))

@@ -110,6 +110,14 @@ def _op_base(op: str) -> str:
     return op.split("#", 1)[0]
 
 
+class _AttendHostFallback(Exception):
+    """A SelfAttend group's dep is not device-resident in the aligned
+    row-sharded layout ring attention needs (producer ran host-tier,
+    or was dropped by a resize): run the group on the host tier, whose
+    broadcast reader has the correct global semantics. Deterministic
+    across SPMD processes — producer residency is."""
+
+
 class _AutoDenseRetry(Exception):
     """An auto-discovered dense-key bound was proven wrong by a later
     wave's badrange signal: the declaration was retracted and the whole
@@ -529,6 +537,13 @@ class MeshExecutor:
         zero-copy conditions, restricted to compile-time facts."""
         if not self._eligible(consumer):
             return True
+        from bigslice_tpu.ops.attention import SelfAttend
+
+        if isinstance(consumer.chain[-1], SelfAttend):
+            # The attend stage reads its broadcast dep zero-copy in
+            # the producer's row-sharded device layout (_dep_input's
+            # SelfAttend branch) — despite the multi-task dep shape.
+            return False
         if dep.tasks[0].num_partition > 1:
             # Partitioned (shuffle) outputs are device-addressed for
             # any consumer shape, including wave-partitioned subid.
@@ -785,8 +800,15 @@ class MeshExecutor:
         if (self.multiprocess
                 and _op_base(task.name.op) in self._spmd_probation):
             return False  # state-keyed SPMD probation (until resize)
+        from bigslice_tpu.ops.attention import SelfAttend
         from bigslice_tpu.ops.cogroup import Cogroup
 
+        if isinstance(task.chain[-1], SelfAttend):
+            # Ring attention spans the WHOLE sequence in one collective
+            # program: wave streaming (shards > devices) would attend
+            # per-wave — host tier handles that scale instead.
+            if task.name.num_shard > self.nmesh:
+                return False
         if isinstance(task.chain[-1], Cogroup):
             # General Cogroup lowers to the tagged-sort group kernel
             # (parallel/cogroup.py) with executor-discovered capacity.
@@ -879,6 +901,13 @@ class MeshExecutor:
             if isinstance(s, GroupByKey):
                 # Consumes the raw shuffled dep: innermost only (its
                 # own op typechecks scalar-device inputs).
+                if s is not task.chain[-1]:
+                    return False
+                continue
+            if isinstance(s, SelfAttend):
+                # Globally-coupled stage: only as the chain's innermost
+                # (it consumes the raw broadcast dep; its own op
+                # typechecks device vector inputs).
                 if s is not task.chain[-1]:
                     return False
                 continue
@@ -1052,6 +1081,27 @@ class MeshExecutor:
                 p.mark_lost(e)
             for t in claimed:
                 t.mark_lost(e)
+        except _AttendHostFallback:
+            # No device-resident aligned input for the collective
+            # attention kernel: run the group's broadcast host tier
+            # (deterministic across processes — producer residency is).
+            # Any dep output that IS mesh-resident must gather first so
+            # the host reader can see it — we are on the dispatcher
+            # thread at the same plan position on every process.
+            if self.multiprocess:
+                try:
+                    for d in tasks[0].deps:
+                        with self._lock:
+                            pout = self._outputs.get(
+                                d.tasks[0].group_key
+                            )
+                        if pout is not None and not pout.gathered:
+                            pout.gather()
+                except Exception:  # noqa: BLE001 — DepLost ladder
+                    pass           # applies on the host read instead
+            for t in claimed:
+                t.set_state(TaskState.WAITING)
+                self.local.submit(t)
         except Exception as e:  # noqa: BLE001
             from bigslice_tpu.utils.distributed import PeerLostError
 
@@ -1529,6 +1579,21 @@ class MeshExecutor:
             # Aligned (materialize-boundary) dep, device-resident:
             # device s holds producer shard s == consumer shard s.
             return out.cols, out.counts, out.capacity, False
+        from bigslice_tpu.ops.attention import SelfAttend
+
+        if isinstance(task0.chain[-1], SelfAttend):
+            # The broadcast dep's MESH layout is the producer's
+            # unpartitioned row-sharded output, read aligned and
+            # zero-copy (device s holds sequence block s). Anything
+            # else (host-tier producer, resize drop) has no layout the
+            # collective kernel can consume — the group falls back to
+            # the host broadcast reader.
+            if (out is not None and getattr(out, "waves", None) is None
+                    and not out.partitioned
+                    and out.cols is not None
+                    and out.nmesh == self.nmesh):
+                return out.cols, out.counts, out.capacity, False
+            raise _AttendHostFallback(str(task0.name))
         if dep0.combine_key:
             # Machine-combined dep whose producers ran the LOCAL
             # shared-buffer tier: per-task store entries are empty by
@@ -1725,6 +1790,7 @@ class MeshExecutor:
     def _stages_for(self, task: Task) -> List[tuple]:
         """Flatten the chain (innermost→outermost) + output partitioner
         into device stage descriptors (kind, struct_id, slice)."""
+        from bigslice_tpu.ops.attention import SelfAttend
         from bigslice_tpu.ops.cogroup import Cogroup
         from bigslice_tpu.ops.fold import Fold
         from bigslice_tpu.ops.groupby import GroupByKey
@@ -1760,6 +1826,12 @@ class MeshExecutor:
                 ))
             elif isinstance(s, GroupByKey):
                 stages.append(("groupby", (s.prefix, s.capacity), s))
+            elif isinstance(s, SelfAttend):
+                stages.append((
+                    "attend",
+                    (s.d, s.causal, str(s.dtype), s.block_q),
+                    s,
+                ))
             elif isinstance(s, Cogroup):
                 # Capacity is executor-discovered (retry ladder in
                 # _execute_wave); it keys the compiled program.
@@ -1979,6 +2051,24 @@ class MeshExecutor:
                     cnk, cnv, cG, axis
                 )(masks, col_sets)
                 overflow = overflow + deficit
+                run_stages = stages[1:]
+            elif stages and stages[0][0] == "attend":
+                # Ring attention over the producer's row-sharded
+                # device output (parallel/ringattention.py): per-device
+                # valid counts mask padded K columns; causal positions
+                # are logical global row indexes.
+                from bigslice_tpu.parallel.ringattention import (
+                    masked_local_body,
+                )
+
+                att = stages[0][2]
+                body = masked_local_body(
+                    axis, nmesh, att.d, causal=att.causal,
+                    dtype=att.dtype, block_q=att.block_q,
+                )
+                o = body(counts_list[0][0], *col_sets[0])
+                cols = [o]
+                mask = masks[0]
                 run_stages = stages[1:]
             else:
                 cols = col_sets[0]

@@ -1,0 +1,95 @@
+"""SelfAttend — global sequence attention as a Slice combinator.
+
+The reference has no attention machinery (SURVEY §5.7); this op wires
+the ring-attention kernel (parallel/ringattention.py) into the slice
+layer so long-context attention is REACHABLE from the same API as
+Reduce/Cogroup rather than a kernel sitting beside the framework
+(round-2 verdict #8).
+
+Input: a slice whose columns are exactly three device vector columns
+q, k, v of shape (d,) — one global sequence in row order (sharded
+contiguously across the input's shards, the Const/ReaderFunc layout).
+Output: one (d,) vector column o, where
+
+    o = softmax(q @ k^T / sqrt(d) [+ causal mask]) @ v
+
+over the GLOBAL sequence. Row order is preserved; row→shard placement
+is an executor detail (as everywhere in the slice model).
+
+Tiers:
+- MESH: the "attend" chain stage — per-device ring attention
+  (ppermute K/V rotation, online softmax, fp32 stats, optional bf16
+  matmuls and Q-block tiling) over the producer's device-resident
+  row-sharded output, zero-copy. Capacity padding is handled by
+  count masking; causal positions are logical global row indexes.
+- HOST: the dep is a BROADCAST read (every shard sees the full
+  sequence — the compiled TaskDep carries every producer task), and
+  shard 0 computes the dense reference while other shards emit
+  nothing. Correct, deliberately unscalable: it is the fallback tier,
+  and global attention has no shard-local host decomposition.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from bigslice_tpu import sliceio, typecheck
+from bigslice_tpu.frame.frame import Frame
+from bigslice_tpu.ops.base import Dep, Slice, make_name
+from bigslice_tpu.slicetype import ColType, Schema
+
+
+class SelfAttend(Slice):
+    """``SelfAttend(slice, causal=False, dtype=np.float32,
+    block_q=0)`` over a (q[d], k[d], v[d]) vector-column slice."""
+
+    def __init__(self, slice_: Slice, causal: bool = False,
+                 dtype=np.float32, block_q: int = 0):
+        typecheck.check(
+            len(slice_.schema) == 3,
+            "selfattend: input must have exactly the (q, k, v) "
+            "columns (got %d columns)", len(slice_.schema),
+        )
+        shapes = [ct.shape for ct in slice_.schema]
+        typecheck.check(
+            all(ct.is_device for ct in slice_.schema)
+            and all(len(sh) == 1 for sh in shapes)
+            and len(set(shapes)) == 1,
+            "selfattend: q, k, v must be device vector columns of one "
+            "shared (d,) shape (got %s)", shapes,
+        )
+        self.d = int(shapes[0][0])
+        self.causal = bool(causal)
+        self.dtype = np.dtype(dtype)
+        self.block_q = int(block_q)
+        schema = Schema([ColType(np.float32, shape=(self.d,))],
+                        prefix=1)
+        super().__init__(schema, slice_.num_shards,
+                         make_name("attend"), pragmas=slice_.pragmas)
+        self.dep_slice = slice_
+
+    def deps(self):
+        # Broadcast: every shard's task reads EVERY producer task's
+        # partition 0 — the host tier needs the whole sequence.
+        return (Dep(self.dep_slice, broadcast=True),)
+
+    def reader(self, shard, deps):
+        if shard != 0:
+            return sliceio.empty_reader()
+
+        def read():
+            from bigslice_tpu.parallel.ringattention import (
+                dense_attention_reference,
+            )
+
+            frame = sliceio.read_all(deps[0](), self.dep_slice.schema)
+            if not len(frame):
+                return
+            host = frame.to_host()
+            q, k, v = (np.asarray(c, np.float32) for c in host.cols)
+            o = dense_attention_reference(
+                q, k, v, causal=self.causal
+            ).astype(np.float32)
+            yield Frame([o], self.schema)
+
+        return read()

@@ -68,12 +68,18 @@ class Dep:
                  ``fn(frame, nparts) -> int32[n]`` (Repartition).
     expand:      partition streams are *merged by sorted key* rather than
                  concatenated (Reduce-style consumers).
+    broadcast:   every consumer shard reads EVERY producer task's
+                 partition 0 (the full dataset) — a fusion boundary,
+                 like shuffle. Host tier of globally-coupled ops
+                 (SelfAttend); the mesh tier reads the producer's
+                 row-sharded device output aligned instead.
     """
 
     slice: "Slice"
     shuffle: bool = False
     partitioner: Optional[Callable] = None
     expand: bool = False
+    broadcast: bool = False
 
 
 class Combiner:

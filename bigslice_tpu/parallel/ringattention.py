@@ -19,9 +19,24 @@ sequence with only O(seq/nmesh) resident keys per device and pure
 neighbor communication (the all-to-all-free formulation; ring attention
 a la Liu et al., blockwise-parallel transformers — public recipe).
 
-This composes with the framework's data plane: a [n, d] sequence rides
-as d scalar columns or one vector column of a Frame, sharded on the
-mesh exactly like shuffle inputs (shard_columns).
+TPU mapping:
+- matmuls run in the input dtype (bf16 on TPU: ``dtype=jnp.bfloat16``)
+  with fp32 accumulation (``preferred_element_type``) — the MXU's
+  native mode; softmax statistics (m, l, acc) stay fp32 regardless;
+- ``block_q`` tiles the local query dim (lax.map over Q blocks) so the
+  per-step score buffer is [block_q, seq/N] instead of
+  [seq/N, seq/N] — the within-device half of flash blocking;
+- the backward pass is autodiff through the (unrolled) ring with each
+  hop's body under ``jax.checkpoint``: residuals are recomputed per
+  hop, so training memory stays O(seq/N · d) per device instead of
+  O(hops · seq/N · seq/N) — the flash-backward memory shape without a
+  hand-written VJP.
+
+This composes with the framework's data plane two ways: standalone on
+[seq, d] global arrays (below), and as the mesh executor's "attend"
+chain stage over vector Frame columns (``masked_local_body``), where
+per-device valid-row counts (capacity padding) mask K columns and set
+global causal positions.
 """
 
 from __future__ import annotations
@@ -31,13 +46,88 @@ import numpy as np
 from bigslice_tpu.parallel.meshutil import get_shard_map, mesh_axis
 
 
+def _online_hop(q, k_blk, v_blk, m, l, acc, scale, score_mask=None):
+    """One online-softmax accumulation step in fp32 stats.
+
+    q: [bq, d] (compute dtype); k_blk/v_blk: [nk, d]; m,l: f32[bq];
+    acc: f32[bq, d]. ``score_mask`` (bool [bq, nk]) marks VALID scores.
+    """
+    import jax.numpy as jnp
+
+    neg_inf = np.float32(-1e30)
+    s = jnp.matmul(
+        q, k_blk.T, preferred_element_type=jnp.float32
+    ) * np.float32(scale)
+    if score_mask is not None:
+        s = jnp.where(score_mask, s, neg_inf)
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    p = jnp.exp(s - m_new[:, None])
+    corr = jnp.exp(m - m_new)
+    l = l * corr + p.sum(axis=-1)
+    acc = acc * corr[:, None] + jnp.matmul(
+        p.astype(v_blk.dtype), v_blk,
+        preferred_element_type=jnp.float32,
+    )
+    return m_new, l, acc
+
+
+def _q_tiling(n: int, block_q: int):
+    """(block, nblocks, pad) for the Q dimension: block_q <= 0 or >= n
+    disables tiling (one block)."""
+    bq = block_q if 0 < block_q < n else n
+    nblk = (n + bq - 1) // bq
+    return bq, nblk, nblk * bq - n
+
+
+def _pad_blocks(x, pad, nblk, bq):
+    """Pad a per-row array to the tiled domain and reshape to
+    [nblk, bq, ...] — done ONCE before the ring loop; statistics stay
+    in this domain across hops."""
+    import jax.numpy as jnp
+
+    widths = ((0, pad),) + ((0, 0),) * (x.ndim - 1)
+    return jnp.pad(x, widths).reshape((nblk, bq) + x.shape[1:])
+
+
+def _hop_update(q3, rows2, valid2, carry, k_blk, v_blk, scale,
+                make_mask):
+    """One K/V block's online update over all Q tiles. ``q3``/``rows2``
+    /``valid2`` live in the padded [nblk, bq, ...] domain, as does the
+    ``carry`` (m, l, acc). ``make_mask(rows_b, valid_b) -> bool
+    [bq, nk] | None`` builds each tile's score mask. nblk == 1 skips
+    the lax.map (no tiling); otherwise the live score buffer is
+    [bq, nk]."""
+    from jax import lax
+
+    m2, l2, a3 = carry
+    if q3.shape[0] == 1:
+        m, l, a = _online_hop(q3[0], k_blk, v_blk, m2[0], l2[0],
+                              a3[0], scale,
+                              make_mask(rows2[0], valid2[0]))
+        return m[None], l[None], a[None]
+
+    def one(args):
+        qb, rb, vb, mb, lb, ab = args
+        return _online_hop(qb, k_blk, v_blk, mb, lb, ab, scale,
+                           make_mask(rb, vb))
+
+    return lax.map(one, (q3, rows2, valid2, m2, l2, a3))
+
+
 def make_ring_attention(mesh, d: int, causal: bool = False,
-                        dtype=np.float32):
-    """Build a jitted ring-attention forward over a 1-D mesh.
+                        dtype=np.float32, block_q: int = 0,
+                        remat: bool = True):
+    """Build a jitted, DIFFERENTIABLE ring-attention forward over a
+    1-D mesh.
 
     Returns ``fn(q, k, v) -> out`` on GLOBAL arrays of shape
-    [seq, d], row-sharded over the mesh (seq % nmesh == 0). ``causal``
-    masks by global positions (block offsets ride the ring step).
+    [seq, d], row-sharded over the mesh (seq % nmesh == 0); out is
+    fp32. ``causal`` masks by global positions (block offsets ride the
+    ring step). ``dtype`` is the matmul compute type (bf16 on TPU);
+    statistics and accumulation are fp32. ``block_q`` > 0 tiles the
+    local query dimension. ``remat`` checkpoints each hop for O(1)-in-
+    hops backward memory; gradients flow via autodiff (d/dq, d/dk,
+    d/dv all supported — see test_ringattention grad tests).
     """
     import jax
     import jax.numpy as jnp
@@ -48,35 +138,46 @@ def make_ring_attention(mesh, d: int, causal: bool = False,
     nmesh = int(mesh.devices.size)
     shard_map = get_shard_map()
     scale = 1.0 / np.sqrt(d)
-    neg_inf = np.array(-1e30, dtype)
 
     def local(q, k, v):
         n_local = q.shape[0]
+        bq, nblk, pad = _q_tiling(n_local, block_q)
         my_blk = lax.axis_index(axis)
         rows = my_blk * n_local + jnp.arange(n_local, dtype=np.int32)
         perm = [(j, (j + 1) % nmesh) for j in range(nmesh)]
 
-        acc = jnp.zeros((n_local, d), dtype)
-        m = jnp.full((n_local,), neg_inf, dtype)
-        l = jnp.zeros((n_local,), dtype)
-        k_blk, v_blk = k, v
-        # Unrolled over the (static) ring length: XLA sees every hop and
-        # can overlap each ppermute with the previous block's matmuls.
-        for i in range(nmesh):
-            # K/V block currently held arrived from device
-            # (my_blk - i) mod nmesh — its global column offset.
+        # Pads are hoisted: inputs AND statistics live in the tiled
+        # [nblk, bq, ...] domain for the whole ring; unpad once at the
+        # end.
+        q3 = _pad_blocks(q.astype(dtype), pad, nblk, bq)
+        rows2 = _pad_blocks(rows, pad, nblk, bq)
+        valid2 = jnp.ones((nblk, bq), bool)  # padding handled by slice
+        m2 = jnp.full((nblk, bq), np.float32(-1e30))
+        l2 = jnp.zeros((nblk, bq), np.float32)
+        a3 = jnp.zeros((nblk, bq, d), np.float32)
+        k_blk, v_blk = k.astype(dtype), v.astype(dtype)
+
+        def hop_body(i, carry, k_blk, v_blk):
             src = (my_blk - i) % nmesh
             cols = src * n_local + jnp.arange(n_local, dtype=np.int32)
-            s = (q @ k_blk.T) * scale  # [n_local, n_local]
-            if causal:
-                s = jnp.where(cols[None, :] <= rows[:, None], s,
-                              neg_inf)
-            m_new = jnp.maximum(m, s.max(axis=-1))
-            p = jnp.exp(s - m_new[:, None])
-            corr = jnp.exp(m - m_new)
-            l = l * corr + p.sum(axis=-1)
-            acc = acc * corr[:, None] + p @ v_blk
-            m = m_new
+
+            def make_mask(rb, vb):
+                del vb
+                if not causal:
+                    return None
+                return cols[None, :] <= rb[:, None]
+
+            return _hop_update(q3, rows2, valid2, carry, k_blk,
+                               v_blk, scale, make_mask)
+
+        hop = jax.checkpoint(hop_body, static_argnums=(0,)) if remat \
+            else hop_body
+
+        # Unrolled over the (static) ring length: XLA sees every hop and
+        # can overlap each ppermute with the previous block's matmuls.
+        carry = (m2, l2, a3)
+        for i in range(nmesh):
+            carry = hop(i, carry, k_blk, v_blk)
             # Rotate K/V one hop around the ring — skipped on the last
             # step (every block is accumulated; the hop's result would
             # be discarded, and ppermute is a blocking neighbor
@@ -84,6 +185,9 @@ def make_ring_attention(mesh, d: int, causal: bool = False,
             if i < nmesh - 1:
                 k_blk = lax.ppermute(k_blk, axis, perm)
                 v_blk = lax.ppermute(v_blk, axis, perm)
+        _, l2, a3 = carry
+        l = l2.reshape(-1)[:n_local]
+        acc = a3.reshape(-1, d)[:n_local]
         # Fully-masked rows (can't happen causally: every row sees
         # itself) would divide by zero; guard anyway.
         return acc / jnp.maximum(l, 1e-30)[:, None]
@@ -95,6 +199,72 @@ def make_ring_attention(mesh, d: int, causal: bool = False,
         out_specs=spec,
         check_rep=False,
     ))
+
+
+def masked_local_body(axis: str, nmesh: int, d: int,
+                      causal: bool = False, dtype=np.float32,
+                      block_q: int = 0):
+    """The mesh executor's "attend" stage core: per-device ring
+    attention over CAPACITY-PADDED vector columns.
+
+    ``fn(count, q, k, v) -> o`` inside shard_map: count is this
+    device's valid-row count (int32 scalar); q/k/v are [cap, d] with
+    garbage beyond count. Invalid K columns are masked out of every
+    score; causal positions are GLOBAL LOGICAL row indexes — the
+    exclusive cumsum of per-device counts (all_gathered, [N]) plus the
+    local valid-row rank — so padding never shifts positions. Output
+    rows beyond count are unspecified (callers carry counts).
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    scale = 1.0 / np.sqrt(d)
+
+    def body(count, q, k, v):
+        cap = q.shape[0]
+        my_blk = lax.axis_index(axis)
+        all_counts = lax.all_gather(count, axis)  # [N]
+        offsets = jnp.cumsum(all_counts) - all_counts  # exclusive
+        idx = jnp.arange(cap, dtype=np.int32)
+        rows = offsets[my_blk] + idx          # logical Q positions
+        perm = [(j, (j + 1) % nmesh) for j in range(nmesh)]
+
+        bq, nblk, pad = _q_tiling(cap, block_q)
+        q3 = _pad_blocks(q.astype(dtype), pad, nblk, bq)
+        rows2 = _pad_blocks(rows, pad, nblk, bq)
+        valid2 = _pad_blocks(idx < count, pad, nblk, bq)
+        carry = (
+            jnp.full((nblk, bq), np.float32(-1e30)),
+            jnp.zeros((nblk, bq), np.float32),
+            jnp.zeros((nblk, bq, d), np.float32),
+        )
+        k_blk, v_blk = k.astype(dtype), v.astype(dtype)
+
+        for i in range(nmesh):
+            # The resident K/V block arrived from device src; its
+            # validity and logical offsets come straight from the
+            # all_gathered counts — no need to rotate scalars.
+            src = (my_blk - i) % nmesh
+            k_valid = idx < all_counts[src]
+            cols = offsets[src] + idx
+
+            def make_mask(rb, vb, k_valid=k_valid, cols=cols):
+                mask = vb[:, None] & k_valid[None, :]
+                if causal:
+                    mask = mask & (cols[None, :] <= rb[:, None])
+                return mask
+
+            carry = _hop_update(q3, rows2, valid2, carry, k_blk,
+                                v_blk, scale, make_mask)
+            if i < nmesh - 1:
+                k_blk = lax.ppermute(k_blk, axis, perm)
+                v_blk = lax.ppermute(v_blk, axis, perm)
+        _, l2, a3 = carry
+        l = l2.reshape(-1)[:cap]
+        acc = a3.reshape(-1, d)[:cap]
+        return acc / jnp.maximum(l, 1e-30)[:, None]
+
+    return body
 
 
 def dense_attention_reference(q, k, v, causal: bool = False):

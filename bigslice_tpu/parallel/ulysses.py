@@ -33,12 +33,17 @@ from bigslice_tpu.parallel.meshutil import get_shard_map, mesh_axis
 
 def make_ulysses_attention(mesh, nheads: int, d: int,
                            causal: bool = False, dtype=np.float32):
-    """Build a jitted all-to-all sequence-parallel attention forward.
+    """Build a jitted, differentiable all-to-all sequence-parallel
+    attention forward.
 
     Returns ``fn(q, k, v) -> out`` on GLOBAL arrays of shape
     [seq, nheads, d], row-sharded over the 1-D mesh. Requires
     ``nheads % nmesh == 0`` (each device owns nheads/nmesh heads in
-    the middle phase) and ``seq % nmesh == 0``.
+    the middle phase) and ``seq % nmesh == 0``. ``dtype`` is the
+    matmul compute type (bf16 on TPU); score/softmax statistics and
+    the output accumulate in fp32 (``preferred_element_type`` — the
+    MXU's native mixed mode). Gradients flow by autodiff through the
+    two all_to_alls (both are linear ops with exact transposes).
     """
     import jax
     import jax.numpy as jnp
@@ -54,7 +59,6 @@ def make_ulysses_attention(mesh, nheads: int, d: int,
         )
     shard_map = get_shard_map()
     scale = 1.0 / np.sqrt(d)
-    neg_inf = np.array(-1e30, dtype)
 
     def local(q, k, v):
         # q/k/v: [seq/N, H, d] per device (sequence-sharded).
@@ -64,22 +68,25 @@ def make_ulysses_attention(mesh, nheads: int, d: int,
             return lax.all_to_all(x, axis, split_axis=1, concat_axis=0,
                                   tiled=True)
 
-        qh = seq_to_head(q)  # [seq, H/N, d]
-        kh = seq_to_head(k)
-        vh = seq_to_head(v)
+        qh = seq_to_head(q).astype(dtype)  # [seq, H/N, d]
+        kh = seq_to_head(k).astype(dtype)
+        vh = seq_to_head(v).astype(dtype)
         seq = qh.shape[0]
 
         # Phase 2: full-sequence attention for the local heads — the
-        # batched-matmul shape XLA tiles straight onto the MXU.
-        s = jnp.einsum("qhd,khd->hqk", qh, kh) * scale
+        # batched-matmul shape XLA tiles straight onto the MXU, in the
+        # compute dtype with fp32 score accumulation.
+        s = jnp.einsum("qhd,khd->hqk", qh, kh,
+                       preferred_element_type=jnp.float32) * scale
         if causal:
             rows = jnp.arange(seq, dtype=np.int32)
             s = jnp.where(rows[None, :, None] >= rows[None, None, :],
-                          s, neg_inf)
+                          s, np.float32(-1e30))
         m = s.max(axis=-1, keepdims=True)
         p = jnp.exp(s - m)
-        o = jnp.einsum("hqk,khd->qhd", p / p.sum(axis=-1, keepdims=True),
-                       vh)
+        p = p / p.sum(axis=-1, keepdims=True)
+        o = jnp.einsum("hqk,khd->qhd", p.astype(dtype), vh,
+                       preferred_element_type=jnp.float32)
 
         # Phase 3: restore sequence sharding — split the sequence dim,
         # concatenate heads back.

@@ -1,0 +1,151 @@
+"""SelfAttend — global sequence attention reachable from the Slice
+layer (round-2 verdict #8 "reachability"), plus the kernel upgrades:
+bf16 compute, Q-block tiling, backward via remat autodiff, and the
+count-masked stage body the mesh executor runs."""
+
+import numpy as np
+import pytest
+
+import jax
+
+import bigslice_tpu as bs
+from bigslice_tpu.exec.meshexec import MeshExecutor
+from bigslice_tpu.exec.session import Session
+from bigslice_tpu.parallel.ringattention import (
+    dense_attention_reference,
+    make_ring_attention,
+)
+
+
+@pytest.fixture
+def mesh():
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(jax.devices()[:8]), ("shards",))
+
+
+def qkv(seq, d, seed=0):
+    rng = np.random.RandomState(seed)
+    return (rng.randn(seq, d).astype(np.float32) * 0.3 for _ in "qkv")
+
+
+def global_qkv(mesh, seq, d, seed=0):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sh = NamedSharding(mesh, P("shards"))
+    return tuple(jax.device_put(x, sh) for x in qkv(seq, d, seed))
+
+
+def test_ring_attention_block_tiled_matches_reference(mesh):
+    q, k, v = qkv(128, 16, seed=1)
+    gq, gk, gv = global_qkv(mesh, 128, 16, seed=1)
+    for causal in (False, True):
+        fn = make_ring_attention(mesh, 16, causal=causal, block_q=4)
+        out = np.asarray(fn(gq, gk, gv))
+        ref = dense_attention_reference(q, k, v, causal=causal)
+        np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
+
+
+def test_ring_attention_bf16_close_to_reference(mesh):
+    import jax.numpy as jnp
+
+    q, k, v = qkv(64, 8, seed=2)
+    gq, gk, gv = global_qkv(mesh, 64, 8, seed=2)
+    fn = make_ring_attention(mesh, 8, dtype=jnp.bfloat16, block_q=8)
+    out = np.asarray(fn(gq, gk, gv))
+    assert out.dtype == np.float32  # fp32 stats/accumulation
+    ref = dense_attention_reference(q, k, v)
+    # bf16 matmuls: ~3 decimal digits.
+    np.testing.assert_allclose(out, ref, rtol=3e-2, atol=3e-2)
+
+
+def test_ring_attention_backward_matches_dense_grad(mesh):
+    """d/dq, d/dk, d/dv through the remat'd ring equal the dense
+    single-device autodiff gradients."""
+    import jax.numpy as jnp
+
+    # seq=128 over 8 devices -> n_local=16; block_q=4 actually tiles.
+    q, k, v = qkv(128, 4, seed=3)
+    gq, gk, gv = global_qkv(mesh, 128, 4, seed=3)
+    fn = make_ring_attention(mesh, 4, causal=True, block_q=4,
+                             remat=True)
+
+    def loss(q_, k_, v_):
+        return jnp.sum(fn(q_, k_, v_) ** 2)
+
+    grads = jax.grad(loss, argnums=(0, 1, 2))(gq, gk, gv)
+
+    def dense_loss(q_, k_, v_):
+        s = (q_ @ k_.T) / np.sqrt(4)
+        mask = jnp.tril(jnp.ones((128, 128), bool))
+        s = jnp.where(mask, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.sum((p @ v_) ** 2)
+
+    ref = jax.grad(dense_loss, argnums=(0, 1, 2))(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)
+    )
+    for g, r in zip(grads, ref):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r),
+                                   rtol=1e-3, atol=1e-4)
+
+
+def test_selfattend_on_mesh_matches_reference(mesh):
+    seq, d = 128, 16
+    q, k, v = qkv(seq, d, seed=4)
+    sess = Session(executor=MeshExecutor(mesh))
+    for causal in (False, True):
+        att = bs.SelfAttend(bs.Const(8, q, k, v, prefix=1),
+                            causal=causal)
+        rows = sess.run(att).rows()
+        out = np.stack([np.asarray(o) for (o,) in rows])
+        ref = dense_attention_reference(q, k, v, causal=causal)
+        np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
+    # The attend group actually ran on the device path.
+    assert any("attend" in t.op for t in sess.executor._task_index)
+
+
+def test_selfattend_host_tier_matches_reference():
+    """LocalExecutor: the broadcast dep gives shard 0 the whole
+    sequence; output rows equal the dense reference."""
+    seq, d = 48, 8
+    q, k, v = qkv(seq, d, seed=5)
+    sess = Session()
+    att = bs.SelfAttend(bs.Const(4, q, k, v, prefix=1), causal=True)
+    rows = sess.run(att).rows()
+    out = np.stack([np.asarray(o) for (o,) in rows])
+    ref = dense_attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_selfattend_uneven_shards_count_masking(mesh):
+    """A sequence length that doesn't divide the mesh exercises the
+    padded-capacity count masking and logical causal positions."""
+    seq, d = 100, 8  # 8 devices -> uneven blocks
+    q, k, v = qkv(seq, d, seed=6)
+    sess = Session(executor=MeshExecutor(mesh))
+    att = bs.SelfAttend(bs.Const(8, q, k, v, prefix=1), causal=True,
+                        block_q=16)
+    rows = sess.run(att).rows()
+    out = np.stack([np.asarray(o) for (o,) in rows])
+    ref = dense_attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
+
+
+def test_selfattend_fused_outer_map(mesh):
+    """A Map over SelfAttend fuses into the attend chain and runs on
+    the device path."""
+    seq, d = 64, 8
+    q, k, v = qkv(seq, d, seed=7)
+    sess = Session(executor=MeshExecutor(mesh))
+    m = bs.Map(bs.SelfAttend(bs.Const(8, q, k, v, prefix=1)),
+               lambda o: o * 2.0)
+    rows = sess.run(m).rows()
+    out = np.stack([np.asarray(o) for (o,) in rows])
+    ref = dense_attention_reference(q, k, v) * 2.0
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
+
+
+def test_selfattend_typechecks():
+    with pytest.raises(Exception):
+        bs.SelfAttend(bs.Const(2, np.arange(8, dtype=np.int32)))
