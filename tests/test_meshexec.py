@@ -806,3 +806,32 @@ def test_hbm_budget_splits_wave(mesh):
         ).rows()
     )
     assert base == oracle
+
+
+def test_wave_stress_64_shards(mesh):
+    """The north-star dispatcher shape: S=64 shards stream 8 waves
+    through the 8-device mesh (wave-partitioned subid shuffle +
+    waved re-combine). Regression guard for the control plane at
+    pod-scale task counts (the BenchmarkEval analog, recorded in
+    BASELINE.md)."""
+    import time
+
+    sess = Session(executor=MeshExecutor(mesh))
+    shards, per = 64, 512
+    n = shards * per
+    rng = np.random.RandomState(17)
+    keys = rng.randint(0, 997, n).astype(np.int32)
+    r = bs.Reduce(bs.Const(shards, keys, np.ones(n, np.int32)),
+                  lambda a, b: a + b)
+    t0 = time.perf_counter()
+    got = dict(sess.run(r).rows())
+    dt = time.perf_counter() - t0
+    assert sum(got.values()) == n
+    oracle = {}
+    for k in keys.tolist():
+        oracle[k] = oracle.get(k, 0) + 1
+    assert got == oracle
+    assert sess.executor.device_group_count() >= 2
+    # Generous wall bound (compile included): catches control-plane
+    # regressions an order of magnitude before they hurt.
+    assert dt < 60.0, f"wave-stress run took {dt:.1f}s"
