@@ -1371,10 +1371,20 @@ class MeshExecutor:
                 for kind, _, s in stages if kind == "map"
                 for a in s.args
             ]
-            out_counts, overflow, badrange, out_cols = program(
+            out_counts, overflow, badrange, gbover, out_cols = program(
                 np.int32(wave), *counts_list, *cols_flat, *extras
             )
             has_shuffle = any(k == "shuffle" for k, _, _ in stages)
+            if int(np.asarray(gbover)) > 0:
+                # Checked BEFORE badrange: a strict capacity overflow
+                # must never trigger the auto-dense retraction path.
+                raise ValueError(
+                    f"groupbykey: group(s) exceed the declared "
+                    f"capacity by up to {int(np.asarray(gbover))} "
+                    f"rows in group {task0.name.op} "
+                    f"(on_overflow='error'); raise capacity or use "
+                    f"Cogroup for discovered capacities"
+                )
             if int(np.asarray(badrange)) > 0:
                 auto = self._declared_auto(task0)
                 if auto is not None:
@@ -1825,7 +1835,12 @@ class MeshExecutor:
                     s,
                 ))
             elif isinstance(s, GroupByKey):
-                stages.append(("groupby", (s.prefix, s.capacity), s))
+                stages.append((
+                    "groupby",
+                    (s.prefix, s.capacity,
+                     getattr(s, "on_overflow", "truncate")),
+                    s,
+                ))
             elif isinstance(s, SelfAttend):
                 stages.append((
                     "attend",
@@ -2031,6 +2046,10 @@ class MeshExecutor:
             extras = list(flat[off:])
             overflow = jnp.int32(0)
             badrange = jnp.int32(0)
+            # Strict-GroupByKey capacity overflow rides its OWN channel:
+            # sharing badrange would let the auto-dense retraction eat a
+            # real overflow (and mislabel dense-range errors as capacity).
+            gbover = jnp.int32(0)
             run_stages = stages
             if stages and stages[0][0] == "join":
                 mask, cols, jbad = join_prelude(stages[0][2], masks,
@@ -2164,6 +2183,21 @@ class MeshExecutor:
                     mask, keys, groups, counts = core(
                         mask, tuple(cols[: s.prefix]), cols[s.prefix]
                     )
+                    if getattr(s, "on_overflow", "truncate") == "error":
+                        # Strict capacity: overflow is a loud user
+                        # error (dedicated gbover channel).
+                        from jax import lax as _lax
+
+                        gbover = gbover + _lax.psum(
+                            jnp.sum(jnp.where(
+                                mask,
+                                jnp.maximum(
+                                    counts - np.int32(s.capacity), 0
+                                ),
+                                0,
+                            )),
+                            axis,
+                        )
                     cols = list(keys) + [groups, counts]
                 else:  # shuffle
                     part = s.partitioner
@@ -2228,10 +2262,11 @@ class MeshExecutor:
             if not mask_dirty:
                 # Map-only single-input chain: counts pass through.
                 return (jnp.asarray(counts_list[0][0]).reshape(1),
-                        overflow, badrange, tuple(cols))
+                        overflow, badrange, gbover, tuple(cols))
             # Final compaction to the front-packed (cols, count) contract.
             out_n, cols = segment.compact_by_mask(mask, cols)
-            return (out_n.reshape(1), overflow, badrange, tuple(cols))
+            return (out_n.reshape(1), overflow, badrange, gbover,
+                    tuple(cols))
 
         if stages and stages[0][0] == "cogroup":
             # Device view of the ragged output: keys, then per input
@@ -2248,7 +2283,7 @@ class MeshExecutor:
             + tuple(col_spec for _ in range(sum(in_ncols)))
             + tuple(P() for _ in range(n_extras))
         )
-        out_specs = (P(axis), P(), P(),
+        out_specs = (P(axis), P(), P(), P(),
                      tuple(col_spec for _ in range(ncols_out)))
         prog = jax.jit(
             shard_map(stepped, mesh=self.mesh, in_specs=in_specs,

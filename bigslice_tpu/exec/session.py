@@ -194,6 +194,22 @@ class Session:
             executor = LocalExecutor(procs=parallelism)
         self.executor = executor
         self.elastic = elastic
+        if (elastic and mesh_provider is None
+                and getattr(executor, "resize", None) is not None):
+            # Built-in demand-driven capacity: elastic sessions default
+            # to probing currently-healthy devices for the retry mesh
+            # (exec/slicemachine.go:586-601's loop at device
+            # granularity). Single-process only — multi-process needs a
+            # coordinated platform provider (the default returns None
+            # there, and the session re-raises the gang loss).
+            from bigslice_tpu.parallel.meshutil import mesh_axis
+            from bigslice_tpu.utils.distributed import (
+                default_mesh_provider,
+            )
+
+            mesh_provider = default_mesh_provider(
+                axis=mesh_axis(executor.mesh)
+            )
         self.mesh_provider = mesh_provider
         self.eventer = eventer
         self.trace_path = trace_path

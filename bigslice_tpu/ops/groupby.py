@@ -27,11 +27,25 @@ from bigslice_tpu.parallel.groupby import cached_group_by_key
 
 
 class GroupByKey(Slice):
-    """``GroupByKey(slice, capacity)`` over a (key..., value) slice with
-    exactly one device value column."""
+    """``GroupByKey(slice, capacity, on_overflow=)`` over a
+    (key..., value) slice with exactly one device value column.
 
-    def __init__(self, slice_: Slice, capacity: int):
+    ``on_overflow``: "truncate" (default) keeps the first ``capacity``
+    values per key with the exact count column making overflow VISIBLE
+    (consumers must check ``count > capacity``); "error" fails the run
+    loudly when any group exceeds capacity — the contract for
+    consumers that would otherwise silently lose data (use ``Cogroup``
+    for executor-discovered capacities with no truncation at all).
+    """
+
+    def __init__(self, slice_: Slice, capacity: int,
+                 on_overflow: str = "truncate"):
         typecheck.check(capacity >= 1, "groupbykey: capacity must be >= 1")
+        typecheck.check(
+            on_overflow in ("truncate", "error"),
+            "groupbykey: on_overflow must be 'truncate' or 'error' "
+            "(got %r)", on_overflow,
+        )
         typecheck.check(
             slice_.prefix >= 1,
             "groupbykey: input slice must have a key prefix",
@@ -61,6 +75,7 @@ class GroupByKey(Slice):
                          pragmas=slice_.pragmas)
         self.dep_slice = slice_
         self.capacity = capacity
+        self.on_overflow = on_overflow
 
     def deps(self):
         return (Dep(self.dep_slice, shuffle=True),)
@@ -77,6 +92,18 @@ class GroupByKey(Slice):
             keys, groups, counts = kern(
                 list(host.key_cols()), host.value_cols()[0], len(host)
             )
+            if self.on_overflow == "error":
+                over = int(np.asarray(
+                    (np.asarray(counts) > self.capacity).sum()
+                ))
+                if over:
+                    biggest = int(np.asarray(counts).max())
+                    raise ValueError(
+                        f"groupbykey: {over} group(s) exceed the "
+                        f"declared capacity {self.capacity} (largest "
+                        f"group: {biggest} rows); raise capacity or "
+                        f"use Cogroup for discovered capacities"
+                    )
             yield Frame(list(keys) + [groups, counts], self.schema)
 
         return read()

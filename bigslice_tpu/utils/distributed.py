@@ -239,3 +239,62 @@ def global_mesh(axis: str = "shards"):
     from jax.sharding import Mesh
 
     return Mesh(np.array(jax.devices()), (axis,))
+
+
+def default_mesh_provider(axis: str = "shards",
+                          probe_timeout: float = 5.0):
+    """Built-in healthy-device discovery for elastic Sessions — the
+    demand-driven capacity loop the reference runs per machine
+    (exec/slicemachine.go:586-601), at device granularity: each call
+    probes every visible device with a tiny put+compute (bounded by
+    ``probe_timeout`` in a worker thread — a wedged device must not
+    hang recovery) and returns a 1-D mesh of the responders, or None
+    when nothing answers (the session then re-raises the original
+    gang loss).
+
+    Single-process scope: in SPMD multi-process mode device health can
+    differ per process, and an asymmetric mesh choice would wedge the
+    gang — supply a platform mesh_provider that coordinates the choice
+    (or restart the driver, the documented SPMD recovery).
+    """
+
+    def provide():
+        import threading
+
+        import numpy as np
+        import jax
+        from jax.sharding import Mesh
+
+        if jax.process_count() > 1:
+            return None  # see docstring: needs a coordinated choice
+
+        import time as _time
+
+        # Probe all devices CONCURRENTLY against one shared deadline:
+        # N wedged devices must cost one probe_timeout, not N of them.
+        devs = jax.devices()
+        ok = [[] for _ in devs]
+
+        def probe(i, dev):
+            try:
+                x = jax.device_put(np.ones((), np.float32), dev)
+                (x + 1).block_until_ready()
+                ok[i].append(True)
+            except Exception:  # noqa: BLE001 — sick device
+                pass
+
+        threads = [
+            threading.Thread(target=probe, args=(i, d), daemon=True)
+            for i, d in enumerate(devs)
+        ]
+        for t in threads:
+            t.start()
+        deadline = _time.monotonic() + probe_timeout
+        for t in threads:
+            t.join(max(0.0, deadline - _time.monotonic()))
+        healthy = [d for i, d in enumerate(devs) if ok[i]]
+        if not healthy:
+            return None
+        return Mesh(np.array(healthy), (axis,))
+
+    return provide

@@ -174,3 +174,22 @@ def test_non_gang_errors_do_not_trigger_elastic_retry():
                         out=[np.int32]))
     assert "app bug" in repr(ei.value)
     assert ex.resize_calls == []  # application errors never resize
+
+
+def test_elastic_default_mesh_provider_recovers():
+    """No mesh_provider given: an elastic session discovers the
+    currently-healthy devices itself (utils.distributed.
+    default_mesh_provider) and retries on them."""
+    keys, vals = keyed_input()
+    ex = _LossyExecutor(make_mesh(8), fail_times=1)
+    sess = Session(executor=ex, elastic=2)
+    res = sess.run(bs.Reduce(bs.Const(8, keys, vals),
+                             lambda a, b: a + b))
+    assert dict(res.rows()) == reduce_oracle(keys, vals)
+    # All CPU devices probe healthy: recovery resized onto the FULL
+    # discovered mesh (a provider regression shrinking it fails here).
+    import jax
+
+    assert ex.resize_calls
+    assert ex.resize_calls[-1] == len(jax.devices())
+    assert ex.device_group_count() >= 1

@@ -209,3 +209,47 @@ def test_groupby_mesh_matches_local():
     meshr = norm(sess.run(build()))
     assert local == meshr
     assert sess.executor.device_group_count() >= 1
+
+
+def test_groupby_strict_overflow_raises_host():
+    import bigslice_tpu as bs
+    from bigslice_tpu.exec.session import Session
+    from bigslice_tpu.exec.task import TaskError
+
+    keys = np.zeros(40, np.int32)  # one group of 40 >> capacity 4
+    vals = np.arange(40, dtype=np.int32)
+    g = bs.GroupByKey(bs.Const(2, keys, vals), capacity=4,
+                      on_overflow="error")
+    with pytest.raises((TaskError, ValueError)) as exc:
+        Session().run(g).rows()
+    assert "capacity" in str(exc.value)
+
+
+def test_groupby_strict_overflow_raises_mesh():
+    import jax
+
+    import bigslice_tpu as bs
+    from bigslice_tpu.exec.meshexec import MeshExecutor
+    from bigslice_tpu.exec.session import Session
+    from bigslice_tpu.exec.task import TaskError
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("shards",))
+    keys = np.zeros(160, np.int32)
+    vals = np.arange(160, dtype=np.int32)
+    g = bs.GroupByKey(bs.Const(8, keys, vals), capacity=4,
+                      on_overflow="error")
+    with pytest.raises((TaskError, ValueError)) as exc:
+        Session(executor=MeshExecutor(mesh)).run(g).rows()
+    assert "capacity" in str(exc.value)
+
+
+def test_groupby_default_still_truncates_visibly():
+    import bigslice_tpu as bs
+    from bigslice_tpu.exec.session import Session
+
+    keys = np.zeros(10, np.int32)
+    vals = np.arange(10, dtype=np.int32)
+    g = bs.GroupByKey(bs.Const(2, keys, vals), capacity=4)
+    ((k, grp, cnt),) = Session().run(g).rows()
+    assert int(cnt) == 10 and len(np.asarray(grp)) == 4  # visible
