@@ -1844,7 +1844,8 @@ class MeshExecutor:
             elif isinstance(s, SelfAttend):
                 stages.append((
                     "attend",
-                    (s.d, s.causal, str(s.dtype), s.block_q),
+                    (s.d, s.causal, str(s.dtype), s.block_q,
+                     getattr(s, "heads", 1)),
                     s,
                 ))
             elif isinstance(s, Cogroup):
@@ -2081,11 +2082,27 @@ class MeshExecutor:
                 )
 
                 att = stages[0][2]
+                heads = getattr(att, "heads", 1)
+                hd = att.d // heads
                 body = masked_local_body(
-                    axis, nmesh, att.d, causal=att.causal,
+                    axis, nmesh, hd, causal=att.causal,
                     dtype=att.dtype, block_q=att.block_q,
                 )
-                o = body(counts_list[0][0], *col_sets[0])
+                count0 = counts_list[0][0]
+                if heads == 1:
+                    o = body(count0, *col_sets[0])
+                else:
+                    # Per-head independence: vmap the ring body over
+                    # the head axis (collectives batch; the per-head
+                    # matmuls fuse into MXU-shaped batched contractions).
+                    cap0 = col_sets[0][0].shape[0]
+                    qh, kh, vh = (
+                        c.reshape(cap0, heads, hd)
+                        for c in col_sets[0]
+                    )
+                    o = jax.vmap(
+                        body, in_axes=(None, 1, 1, 1), out_axes=1
+                    )(count0, qh, kh, vh).reshape(cap0, att.d)
                 cols = [o]
                 mask = masks[0]
                 run_stages = stages[1:]

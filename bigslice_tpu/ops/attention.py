@@ -40,11 +40,17 @@ from bigslice_tpu.slicetype import ColType, Schema
 
 
 class SelfAttend(Slice):
-    """``SelfAttend(slice, causal=False, dtype=np.float32,
-    block_q=0)`` over a (q[d], k[d], v[d]) vector-column slice."""
+    """``SelfAttend(slice, causal=False, dtype=np.float32, block_q=0,
+    heads=1)`` over a (q[D], k[D], v[D]) vector-column slice.
+
+    ``heads > 1`` interprets each ``D = heads * head_dim`` vector as
+    stacked heads: attention runs independently per head (the mesh
+    stage vmaps the ring kernel over the head axis — K/V rotation and
+    count masking are shared; per-head math batches on the MXU).
+    """
 
     def __init__(self, slice_: Slice, causal: bool = False,
-                 dtype=np.float32, block_q: int = 0):
+                 dtype=np.float32, block_q: int = 0, heads: int = 1):
         typecheck.check(
             len(slice_.schema) == 3,
             "selfattend: input must have exactly the (q, k, v) "
@@ -59,6 +65,12 @@ class SelfAttend(Slice):
             "shared (d,) shape (got %s)", shapes,
         )
         self.d = int(shapes[0][0])
+        typecheck.check(
+            heads >= 1 and self.d % heads == 0,
+            "selfattend: heads (%s) must divide the vector width (%s)",
+            heads, self.d,
+        )
+        self.heads = int(heads)
         self.causal = bool(causal)
         self.dtype = np.dtype(dtype)
         self.block_q = int(block_q)
@@ -78,8 +90,8 @@ class SelfAttend(Slice):
             return sliceio.empty_reader()
 
         def read():
-            from bigslice_tpu.parallel.ringattention import (
-                dense_attention_reference,
+            from bigslice_tpu.parallel.ulysses import (
+                dense_mha_reference,
             )
 
             frame = sliceio.read_all(deps[0](), self.dep_slice.schema)
@@ -87,9 +99,13 @@ class SelfAttend(Slice):
                 return
             host = frame.to_host()
             q, k, v = (np.asarray(c, np.float32) for c in host.cols)
-            o = dense_attention_reference(
-                q, k, v, causal=self.causal
-            ).astype(np.float32)
+            # One oracle covers both: heads == 1 is MHA with a single
+            # head (bit-identical to the single-head reference).
+            hd = self.d // self.heads
+            o = dense_mha_reference(
+                *(x.reshape(-1, self.heads, hd) for x in (q, k, v)),
+                causal=self.causal,
+            ).reshape(-1, self.d).astype(np.float32)
             yield Frame([o], self.schema)
 
         return read()
