@@ -192,6 +192,23 @@ def worker(num_processes: int, process_id: int, port: int,
     assert any("cogroup" in t.op for t in ex._task_index)
     assert max(ex._cogroup_caps.values()) >= n * 8
 
+    # Slice-level ring attention across REAL process boundaries: the
+    # attend stage's ppermute ring and count all_gather ride DCN.
+    from bigslice_tpu.parallel.ulysses import dense_mha_reference
+
+    a_seq, a_d = n * 8, 8
+    aq, akk, av = (rng.randn(a_seq, a_d).astype(np.float32) * 0.3
+                   for _ in range(3))
+    att = bs.SelfAttend(bs.Const(n, aq, akk, av), causal=True)
+    a_out = np.stack([np.asarray(o)
+                      for (o,) in sess.run(att).rows()])
+    a_ref = dense_mha_reference(
+        aq[:, None, :], akk[:, None, :], av[:, None, :], causal=True
+    )[:, 0, :]
+    assert np.allclose(a_out, a_ref, rtol=3e-4, atol=3e-4), \
+        np.abs(a_out - a_ref).max()
+    assert any("attend" in t.op for t in ex._task_index)
+
     # Iterative reuse across runs (Result as input) under SPMD.
     base = sess.run(bs.Const(n, np.arange(n * 8, dtype=np.int32)))
     doubled = sorted(sess.run(bs.Map(base, lambda x: x * 2)).rows())
