@@ -22,6 +22,9 @@ Modes (argv[1], default "reduce"):
                   ScanReader → host parse → dict-encode → device Reduce,
                   all through the Session (models/urls).
 - ``sortshuffle`` config #4: Reshuffle + per-shard device sort.
+- ``cogroup``     the general ragged Cogroup: device tagged-sort +
+                  rank-scatter lowering (discovered capacity) vs the
+                  exact host sorted-merge tier as baseline.
 - ``kmeans``      config #5: iterative Session k-means (Map with
                   unbatched centroid arg + Reduce over a reused Result);
                   raw jitted-step TFLOP/s noted as the MXU roofline.
@@ -389,6 +392,54 @@ def sortshuffle_bench(n_rows: int, iters: int = 3):
     return n_rows / min(times), cpu_sortshuffle_baseline(keys)
 
 
+# --------------------------------------------------------------- cogroup
+
+def cogroup_bench(n_rows: int, n_keys: int = 1 << 12, iters: int = 2):
+    """The general ragged Cogroup: device lowering (one tagged sort +
+    rank-scatter with discovered capacity, parallel/cogroup.py) vs the
+    host sorted-merge tier on the same pipeline — the cogroup.go:46-272
+    workhorse, beyond the aggregating-join config #3."""
+    import bigslice_tpu as bs
+    from bigslice_tpu.exec.session import Session
+
+    rng = np.random.RandomState(13)
+    keys = rng.randint(0, n_keys, n_rows).astype(np.int32)
+    vals = rng.randint(0, 1 << 20, n_rows).astype(np.int32)
+    mesh = _mesh()
+    sess = _mesh_session(mesh)
+    n = mesh.devices.size
+
+    def run_once(s):
+        res = s.run(bs.Cogroup(bs.Const(n, keys, vals)))
+        groups = 0
+        rows = 0
+        for f in res.frames():
+            groups += len(f)
+            for g in f.to_host().cols[1]:
+                rows += len(g)
+        res.discard()
+        # No silent row loss: discovered capacity must never truncate.
+        assert rows == n_rows, (rows, n_rows)
+        return groups
+
+    groups = run_once(sess)
+    note(f"cogroup: {groups} groups from {n_rows} rows, device "
+         f"groups {sess.executor.device_group_count()}")
+    if sess.executor.device_group_count() == 0:
+        raise RuntimeError("cogroup never engaged the device path")
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        run_once(sess)
+        times.append(time.perf_counter() - t0)
+
+    host_sess = Session()  # the exact sorted-merge tier as baseline
+    t0 = time.perf_counter()
+    run_once(host_sess)
+    host_dt = time.perf_counter() - t0
+    return n_rows / min(times), n_rows / host_dt
+
+
 # ---------------------------------------------------------------- kmeans
 
 def kmeans_bench(n_points: int, d: int, k: int, rounds: int = 3,
@@ -652,6 +703,10 @@ def run_mode(mode: str, size, fallback: bool) -> None:
         n_rows = size or (1 << 20 if fallback else 1 << 24)
         dev, base = sortshuffle_bench(n_rows)
         emit("shuffle_sort_rows_per_sec", dev, "rows/sec", base)
+    elif mode == "cogroup":
+        n_rows = size or (1 << 18 if fallback else 1 << 22)
+        dev, base = cogroup_bench(n_rows)
+        emit("cogroup_rows_per_sec", dev, "rows/sec", base)
     elif mode == "attention":
         import jax
 
@@ -674,7 +729,8 @@ def run_mode(mode: str, size, fallback: bool) -> None:
 # driver parses the tail JSON line (VERDICT r2 #1). Fast sizes so the
 # full sweep stays bounded even on the 1-vCPU fallback.
 MATRIX = ("reduce-sort", "reduce-dense", "join", "join-dense",
-          "wordcount", "sortshuffle", "kmeans", "attention", "reduce")
+          "wordcount", "sortshuffle", "cogroup", "kmeans", "attention",
+          "reduce")
 
 # Fast matrix sizes per mode (None → the mode's own fallback default).
 _MATRIX_SIZES = {
@@ -686,6 +742,7 @@ _MATRIX_SIZES = {
     "wordcount": 1 << 17,
     "sortshuffle": 1 << 19,
     "kmeans": 1 << 12,
+    "cogroup": 1 << 16,
     "attention": 1 << 10,
 }
 
@@ -722,7 +779,7 @@ def main():
     args = sys.argv[1:]
     known = ("reduce", "reduce-sort", "reduce-dense", "reduce-kernel",
              "join", "join-dense", "join-kernel", "wordcount",
-             "sortshuffle", "kmeans", "attention", "matrix")
+             "sortshuffle", "cogroup", "kmeans", "attention", "matrix")
     mode = "matrix"
     if args and args[0] in known:
         mode = args.pop(0)
