@@ -341,6 +341,10 @@ class MeshExecutor:
         self.device_budget_bytes = device_budget_bytes
         # op base -> K of the last split run (observability/tests).
         self.split_runs: Dict[str, int] = {}
+        # op base -> chosen attend lowering ("ring"/"ulysses"),
+        # recorded at program trace time (deterministic per stage
+        # struct, so cached-program reuse keeps it accurate).
+        self.attend_methods: Dict[str, str] = {}
         # Automatic dense-key discovery (staging-time min/max probe →
         # table+collective lowering without a dense_keys= annotation).
         # Off for A/B benchmarks of the generic sort path.
@@ -1845,7 +1849,8 @@ class MeshExecutor:
                 stages.append((
                     "attend",
                     (s.d, s.causal, str(s.dtype), s.block_q,
-                     getattr(s, "heads", 1)),
+                     getattr(s, "heads", 1),
+                     getattr(s, "method", "auto")),
                     s,
                 ))
             elif isinstance(s, Cogroup):
@@ -2083,26 +2088,57 @@ class MeshExecutor:
 
                 att = stages[0][2]
                 heads = getattr(att, "heads", 1)
+                method = getattr(att, "method", "auto")
                 hd = att.d // heads
-                body = masked_local_body(
-                    axis, nmesh, hd, causal=att.causal,
-                    dtype=att.dtype, block_q=att.block_q,
-                )
                 count0 = counts_list[0][0]
-                if heads == 1:
-                    o = body(count0, *col_sets[0])
-                else:
-                    # Per-head independence: vmap the ring body over
-                    # the head axis (collectives batch; the per-head
-                    # matmuls fuse into MXU-shaped batched contractions).
-                    cap0 = col_sets[0][0].shape[0]
+                cap0 = col_sets[0][0].shape[0]
+                # 'auto' defers to the ring when the user bounded score
+                # memory with block_q — the Ulysses body materializes
+                # the full padded-seq score tensor (N x the ring's
+                # footprint) and has no tiling; an explicit
+                # method='ulysses' overrides.
+                use_ulysses = (heads % nmesh == 0 and heads > 1
+                               and (method == "ulysses"
+                                    or (method == "auto"
+                                        and att.block_q == 0)))
+                self.attend_methods[_op_base(task.name.op)] = (
+                    "ulysses" if use_ulysses else "ring"
+                )
+                if use_ulysses:
+                    # Plentiful heads: two all_to_alls total beat N
+                    # ppermute hops (parallel/ulysses.py).
+                    from bigslice_tpu.parallel.ulysses import (
+                        masked_local_body as ulysses_body,
+                    )
+
+                    body = ulysses_body(
+                        axis, nmesh, heads, hd, causal=att.causal,
+                        dtype=att.dtype,
+                    )
                     qh, kh, vh = (
                         c.reshape(cap0, heads, hd)
                         for c in col_sets[0]
                     )
-                    o = jax.vmap(
-                        body, in_axes=(None, 1, 1, 1), out_axes=1
-                    )(count0, qh, kh, vh).reshape(cap0, att.d)
+                    o = body(count0, qh, kh, vh).reshape(cap0, att.d)
+                else:
+                    body = masked_local_body(
+                        axis, nmesh, hd, causal=att.causal,
+                        dtype=att.dtype, block_q=att.block_q,
+                    )
+                    if heads == 1:
+                        o = body(count0, *col_sets[0])
+                    else:
+                        # Per-head independence: vmap the ring body
+                        # over the head axis (collectives batch; the
+                        # per-head matmuls fuse into MXU-shaped
+                        # batched contractions).
+                        qh, kh, vh = (
+                            c.reshape(cap0, heads, hd)
+                            for c in col_sets[0]
+                        )
+                        o = jax.vmap(
+                            body, in_axes=(None, 1, 1, 1), out_axes=1
+                        )(count0, qh, kh, vh).reshape(cap0, att.d)
                 cols = [o]
                 mask = masks[0]
                 run_stages = stages[1:]

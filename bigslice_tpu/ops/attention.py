@@ -44,13 +44,21 @@ class SelfAttend(Slice):
     heads=1)`` over a (q[D], k[D], v[D]) vector-column slice.
 
     ``heads > 1`` interprets each ``D = heads * head_dim`` vector as
-    stacked heads: attention runs independently per head (the mesh
-    stage vmaps the ring kernel over the head axis — K/V rotation and
-    count masking are shared; per-head math batches on the MXU).
+    stacked heads: attention runs independently per head. The mesh
+    stage picks between the two public sequence-parallel lowerings:
+    the RING (vmapped over heads — K/V rotate by ppermute, O(seq/N)
+    resident keys, honors ``block_q`` score tiling) and ULYSSES
+    (head/sequence all_to_all re-shard, two collectives total, full
+    padded-seq score tensor — "auto" picks it when heads divide the
+    mesh AND no ``block_q`` memory bound is set). ``method`` pins one
+    explicitly ("ulysses" falls back to the ring when heads don't
+    divide the mesh). Both tiers are exact for any method; the choice
+    is a performance shape, not a semantic one.
     """
 
     def __init__(self, slice_: Slice, causal: bool = False,
-                 dtype=np.float32, block_q: int = 0, heads: int = 1):
+                 dtype=np.float32, block_q: int = 0, heads: int = 1,
+                 method: str = "auto"):
         typecheck.check(
             len(slice_.schema) == 3,
             "selfattend: input must have exactly the (q, k, v) "
@@ -70,6 +78,12 @@ class SelfAttend(Slice):
             "selfattend: heads (%s) must divide the vector width (%s)",
             heads, self.d,
         )
+        typecheck.check(
+            method in ("auto", "ring", "ulysses"),
+            "selfattend: method must be 'auto', 'ring', or 'ulysses' "
+            "(got %r)", method,
+        )
+        self.method = method
         self.heads = int(heads)
         self.causal = bool(causal)
         self.dtype = np.dtype(dtype)

@@ -102,6 +102,64 @@ def make_ulysses_attention(mesh, nheads: int, d: int,
     ))
 
 
+def masked_local_body(axis: str, nmesh: int, nheads: int, d: int,
+                      causal: bool = False, dtype=np.float32):
+    """The mesh executor's Ulysses "attend" stage core: all-to-all
+    sequence-parallel attention over CAPACITY-PADDED vector columns.
+
+    ``fn(count, q, k, v) -> o`` inside shard_map: count is this
+    device's valid-row count; q/k/v are [cap, H, d] with garbage
+    beyond count and ``H % nmesh == 0``. Phase 1 re-shards to
+    [N*cap, H/N, d] (head-sharded, the padded global sequence in
+    device order); invalid rows are masked out of every score and
+    causal positions are logical global row indexes (offsets from the
+    all_gathered counts), so padding never shifts attention. Phase 3
+    restores sequence sharding. Chosen over the ring when heads are
+    plentiful: two all_to_alls total instead of N ppermute hops."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    scale = 1.0 / np.sqrt(d)
+    neg_inf = np.float32(-1e30)
+
+    def body(count, q, k, v):
+        cap = q.shape[0]
+        all_counts = lax.all_gather(count, axis)       # [N]
+        offsets = jnp.cumsum(all_counts) - all_counts  # exclusive
+
+        def seq_to_head(x):
+            return lax.all_to_all(x, axis, split_axis=1,
+                                  concat_axis=0, tiled=True)
+
+        qh = seq_to_head(q.astype(dtype))  # [N*cap, H/N, d]
+        kh = seq_to_head(k.astype(dtype))
+        vh = seq_to_head(v.astype(dtype))
+
+        # Padded-global-row validity and logical positions: row
+        # i*cap + j belongs to device i's block.
+        blk = jnp.repeat(jnp.arange(nmesh, dtype=np.int32), cap)
+        j = jnp.tile(jnp.arange(cap, dtype=np.int32), nmesh)
+        valid = j < all_counts[blk]
+        pos = offsets[blk] + j
+
+        s = jnp.einsum("qhd,khd->hqk", qh, kh,
+                       preferred_element_type=jnp.float32) * scale
+        mask = valid[None, :, None] & valid[None, None, :]
+        if causal:
+            mask = mask & (pos[None, :, None] >= pos[None, None, :])
+        s = jnp.where(mask, s, neg_inf)
+        m = s.max(axis=-1, keepdims=True)
+        p = jnp.exp(s - m)
+        p = p / jnp.maximum(p.sum(axis=-1, keepdims=True), 1e-30)
+        o = jnp.einsum("hqk,khd->qhd", p.astype(dtype), vh,
+                       preferred_element_type=jnp.float32)
+        # Phase 3: back to sequence sharding, heads re-concatenated.
+        return lax.all_to_all(o, axis, split_axis=0, concat_axis=1,
+                              tiled=True)
+
+    return body
+
+
 def dense_mha_reference(q, k, v, causal: bool = False):
     """Host oracle for tests: per-head softmax(QK^T/sqrt(d))V on
     [seq, H, d] arrays."""

@@ -182,3 +182,69 @@ def test_selfattend_heads_typecheck():
     q = np.zeros((8, 6), np.float32)
     with pytest.raises(Exception):
         bs.SelfAttend(bs.Const(2, q, q, q), heads=4)  # 6 % 4 != 0
+
+
+def test_selfattend_ulysses_lowering_matches_ring(mesh):
+    """heads % nmesh == 0 picks the Ulysses all_to_all lowering on
+    'auto'; results match the pinned ring and the dense oracle,
+    including uneven per-shard counts (padded-row masking + logical
+    positions across the re-shard)."""
+    from bigslice_tpu.parallel.ulysses import dense_mha_reference
+
+    seq, H, dh = 90, 8, 4  # 90 % 8 != 0: truly uneven shard counts
+    rng = np.random.RandomState(9)
+    q3, k3, v3 = (rng.randn(seq, H, dh).astype(np.float32) * 0.3
+                  for _ in range(3))
+    flat = [x.reshape(seq, H * dh) for x in (q3, k3, v3)]
+    ref = dense_mha_reference(q3, k3, v3, causal=True).reshape(
+        seq, H * dh)
+
+    outs = {}
+    for method in ("auto", "ring", "ulysses"):
+        sess = Session(executor=MeshExecutor(mesh))
+        att = bs.SelfAttend(bs.Const(8, *flat), causal=True, heads=H,
+                            method=method)
+        outs[method] = np.stack([
+            np.asarray(o) for (o,) in sess.run(att).rows()
+        ])
+        np.testing.assert_allclose(outs[method], ref, rtol=3e-4,
+                                   atol=3e-4)
+        assert any("attend" in t.op for t in sess.executor._task_index)
+        chosen = set(sess.executor.attend_methods.values())
+        expect_method = "ring" if method == "ring" else "ulysses"
+        assert chosen == {expect_method}, (method, chosen)
+    # auto == ulysses here (H divides the mesh); ring agrees to fp.
+    np.testing.assert_allclose(outs["auto"], outs["ulysses"],
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_selfattend_ulysses_indivisible_heads_fall_back_to_ring(mesh):
+    """method='ulysses' with heads that don't divide the mesh runs the
+    ring instead — same results, no failure."""
+    from bigslice_tpu.parallel.ulysses import dense_mha_reference
+
+    seq, H, dh = 64, 3, 8  # 3 heads on 8 devices
+    rng = np.random.RandomState(10)
+    q3, k3, v3 = (rng.randn(seq, H, dh).astype(np.float32) * 0.3
+                  for _ in range(3))
+    flat = [x.reshape(seq, H * dh) for x in (q3, k3, v3)]
+    sess = Session(executor=MeshExecutor(mesh))
+    att = bs.SelfAttend(bs.Const(8, *flat), heads=H, method="ulysses")
+    out = np.stack([np.asarray(o) for (o,) in sess.run(att).rows()])
+    ref = dense_mha_reference(q3, k3, v3).reshape(seq, H * dh)
+    np.testing.assert_allclose(out, ref, rtol=3e-4, atol=3e-4)
+    assert set(sess.executor.attend_methods.values()) == {"ring"}
+
+
+def test_selfattend_auto_with_block_q_keeps_the_tiled_ring(mesh):
+    """block_q bounds score memory; 'auto' must not silently trade it
+    for Ulysses' full-seq score tensor."""
+    seq, H, dh = 64, 8, 4
+    rng = np.random.RandomState(12)
+    q3, k3, v3 = (rng.randn(seq, H, dh).astype(np.float32) * 0.3
+                  for _ in range(3))
+    flat = [x.reshape(seq, H * dh) for x in (q3, k3, v3)]
+    sess = Session(executor=MeshExecutor(mesh))
+    att = bs.SelfAttend(bs.Const(8, *flat), heads=H, block_q=4)
+    sess.run(att).rows()
+    assert set(sess.executor.attend_methods.values()) == {"ring"}
