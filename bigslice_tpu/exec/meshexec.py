@@ -16,14 +16,18 @@ jitted ``shard_map`` computation over a ``jax.sharding.Mesh``:
   segmented-scan kernel — shuffle edges in the task DAG become ICI
   collectives rather than stored partitions;
 - groups that are not device-eligible (host columns, host functions,
-  Cogroup, custom partitioners, sinks) fall back to the local executor.
-  A store bridge materializes device outputs as frames on demand, so
-  fallback consumers and result scans read mesh outputs transparently.
+  frame-level host partitioners, sinks) fall back to the local
+  executor. A store bridge materializes device outputs as frames on
+  demand, so fallback consumers and result scans read mesh outputs
+  transparently.
 
-Eligibility (v1): the group's shard count equals the mesh size; its
-output partition count is 1 or the mesh size; every chain stage is a
-supported op with a device-tier schema. Everything else falls back —
-correctness never depends on the mesh path.
+Eligibility: shard counts and the mesh size decouple (padded meshes
+for S < N, wave streaming for S > N); every chain stage must be a
+supported op with a device-tier schema — including the general ragged
+Cogroup (discovered-capacity tagged-sort lowering), GroupByKey,
+JoinAggregate, machine-combined groups, and SelfAttend (ring/Ulysses
+sequence parallelism). Everything else falls back — correctness never
+depends on the mesh path.
 """
 
 from __future__ import annotations
