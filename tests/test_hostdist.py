@@ -278,3 +278,34 @@ def test_distributable_excludes_machine_combined():
     assert ex.distributable(t)
     t.partitioner.combine_key = "mc-1"
     assert not ex.distributable(t)
+
+
+def test_abort_run_publishes_markers_and_floor_ignores_them():
+    """A dead run's abort markers resolve remote waiters to ERR, but a
+    FRESH submission records them as an epoch floor and keeps waiting
+    for the owner's re-publication."""
+    t = make_task(shard=0, op="map-0")  # owner = 0
+    ex = make_exchange(pid=0)
+    ex.executor._eligible = lambda task: False  # host-tier classified
+    t.set_state(TaskState.WAITING)
+    ex.abort_run([t], RuntimeError("boom"))
+    base = _base_key(t.name)
+    assert ex.client.kv[f"bigslice/hostdist/{base}/e"] == "0"
+    st = ex.client.kv[f"bigslice/hostdist/{base}/a0/state"]
+    assert st.startswith("err:run aborted")
+
+    # Non-owner side: a fresh submit on another exchange sharing the
+    # KV records floor=0 and does NOT resolve from the stale marker.
+    peer = make_exchange(pid=1)
+    peer.client = ex.client
+    t2 = make_task(shard=0, op="map-0")
+    t2.set_state(TaskState.WAITING)
+    assert peer.submit(t2) is True
+    _, _, _, floor = peer._pending[base]
+    assert floor == 0
+    assert peer._resolve_state(base, floor) is None  # stale ignored
+    # Owner re-publishes (epoch 1): now it resolves.
+    store = FakeStore({(t.name, 0): [int_frame([5])]})
+    ex.executor = FakeExecutor(store)
+    ex._publish_epoch(t, "ok")
+    assert peer._resolve_state(base, floor) == "ok"

@@ -132,6 +132,7 @@ def main() -> None:
             lambda: (bench.join_e2e_bench(n, dense=True),
                      bench.cpu_join_baseline(*bench.join_inputs(n))))
 
+    run(f"cogroup_{1 << 20}", lambda: bench.cogroup_bench(1 << 20))
     run(f"wordcount_{1 << 20}", lambda: bench.wordcount_bench(1 << 20))
     run(f"sortshuffle_{1 << 22}",
         lambda: bench.sortshuffle_bench(1 << 22))
