@@ -47,6 +47,7 @@ from bigslice_tpu.ops.cogroup import Cogroup
 from bigslice_tpu.ops.join import JoinAggregate
 from bigslice_tpu.ops.groupby import GroupByKey
 from bigslice_tpu.ops.attention import SelfAttend
+from bigslice_tpu.ops.parquet import ParquetReader
 from bigslice_tpu.ops.reshuffle import Reshuffle, Repartition, Reshard
 from bigslice_tpu.ops.cache import Cache, CachePartial, ReadCache
 
@@ -81,6 +82,7 @@ __all__ = [
     "JoinAggregate",
     "GroupByKey",
     "SelfAttend",
+    "ParquetReader",
     "Reshuffle",
     "Repartition",
     "Reshard",

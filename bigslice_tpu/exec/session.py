@@ -139,6 +139,41 @@ class Result(Slice):
             out.extend(f.rows())
         return out
 
+    def _merged(self, frames) -> "Frame":
+        from bigslice_tpu.frame.frame import Frame
+
+        frames = list(frames)
+        return Frame.concat(frames) if frames else Frame.empty(
+            self.schema
+        )
+
+    def to_arrow(self, names=None):
+        """All result rows as one ``pyarrow.Table`` (frame/arrow.py
+        mapping: vector columns → FixedSizeList, ragged group lists →
+        List, strings → String)."""
+        from bigslice_tpu.frame import arrow
+
+        return arrow.to_arrow(self._merged(self.frames()), names=names)
+
+    def to_pandas(self, names=None):
+        """All result rows as a ``pandas.DataFrame``."""
+        return self.to_arrow(names=names).to_pandas()
+
+    def write_parquet(self, url_prefix: str, names=None) -> None:
+        """Write one parquet file PER SHARD as
+        ``{url_prefix}-NNNN-of-MMMM.parquet`` (the Cache family's
+        sharded naming, over any fsspec scheme). Empty shards write
+        empty files so the set is complete."""
+        from bigslice_tpu.frame import arrow
+
+        m = self.num_shards
+        for shard in range(m):
+            arrow.write_parquet(
+                self._merged(self.reader(shard, ())),
+                f"{url_prefix}-{shard:04d}-of-{m:04d}.parquet",
+                names=names,
+            )
+
     def discard(self) -> None:
         """Drop stored task outputs (exec/session.go Discard)."""
         for t in self.tasks:

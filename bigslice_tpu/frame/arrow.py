@@ -1,0 +1,187 @@
+"""Arrow / Parquet interchange at the host codec boundary.
+
+The reference's IO story is Go readers over gob/flat files; the
+columnar ecosystem equivalent for this framework is Apache Arrow —
+``Frame`` is already a struct-of-arrays table, so the mapping is
+direct and zero-copy where Arrow allows:
+
+    device scalar column  <-> pa.Array of the same primitive type
+    device vector column  <-> pa.FixedSizeListArray (trailing dim)
+    host "str" column     <-> pa.StringArray
+    host list cells       <-> pa.ListArray (ragged — Cogroup output)
+    other host objects    -> refused loudly (no silent pickling)
+
+Parquet read/write goes through fsspec like the store tier
+(exec/store.py), so gs://, s3://, memory:// and local paths all work.
+The sharded-source slice lives in ops/parquet.py; Result convenience
+methods (``to_arrow``/``to_pandas``/``write_parquet``) in
+exec/session.py.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from bigslice_tpu import typecheck
+from bigslice_tpu.frame.frame import Frame
+from bigslice_tpu.slicetype import ColType, Schema
+
+
+def _require_pyarrow():
+    try:
+        import pyarrow as pa  # noqa: F401
+
+        return pa
+    except Exception as e:  # pragma: no cover — baked into the image
+        raise RuntimeError(
+            "pyarrow is required for Arrow/Parquet interchange"
+        ) from e
+
+
+def _downcast(a: np.ndarray) -> np.ndarray:
+    """The device tier is 32-bit-first (docs/design.md §2): downcast
+    64-bit arrow/parquet numerics on entry, like Const."""
+    if a.dtype == np.int64:
+        return a.astype(np.int32)
+    if a.dtype == np.float64:
+        return a.astype(np.float32)
+    if a.dtype == np.uint64:
+        return a.astype(np.uint32)
+    return a
+
+
+def to_arrow(frame: Frame, names: Optional[Sequence[str]] = None):
+    """``Frame -> pyarrow.Table``. Column names default to c0..cN with
+    the key prefix recorded in the schema metadata (round-trips through
+    ``from_arrow``)."""
+    pa = _require_pyarrow()
+
+    host = frame.to_host()
+    arrays = []
+    fields = []
+    names = list(names) if names is not None else [
+        f"c{i}" for i in range(host.num_cols)
+    ]
+    typecheck.check(
+        len(names) == host.num_cols,
+        "to_arrow: %d names for %d columns", len(names), host.num_cols,
+    )
+    for name, col, ct in zip(names, host.cols, host.schema):
+        if ct.is_device and not ct.shape:
+            arr = pa.array(np.asarray(col))
+        elif ct.is_device:
+            flat = pa.array(np.asarray(col).reshape(-1))
+            arr = pa.FixedSizeListArray.from_arrays(
+                flat, int(np.prod(ct.shape))
+            )
+        elif ct.tag == "list":
+            arr = pa.array(
+                [list(np.asarray(x).tolist())
+                 if not isinstance(x, list) else x for x in col]
+            )
+            vt = getattr(arr.type, "value_type", None)
+            if pa.types.is_null(arr.type) or (
+                vt is not None and pa.types.is_null(vt)
+            ):
+                # Empty shard / all-empty groups: a null-typed column
+                # would break dataset schema unification and lose the
+                # list tag on the way back — pin list<int32>.
+                arr = arr.cast(pa.list_(pa.int32()))
+        else:
+            # String-tagged or untagged object columns of strings.
+            typecheck.check(
+                all(isinstance(x, str) for x in col),
+                "to_arrow: host column %r holds non-string objects "
+                "(%s); only str and list host payloads interchange",
+                name, ct,
+            )
+            arr = pa.array(list(col), type=pa.string())
+        arrays.append(arr)
+        fields.append(pa.field(name, arr.type))
+    schema = pa.schema(
+        fields, metadata={b"bigslice_prefix": str(frame.prefix).encode()}
+    )
+    return pa.Table.from_arrays(arrays, schema=schema)
+
+
+def from_arrow(table, prefix: Optional[int] = None) -> Frame:
+    """``pyarrow.Table -> Frame``. ``prefix`` defaults to the
+    ``bigslice_prefix`` metadata written by ``to_arrow`` (else 1)."""
+    pa = _require_pyarrow()
+
+    if prefix is None:
+        meta = table.schema.metadata or {}
+        prefix = int(meta.get(b"bigslice_prefix", b"1"))
+    cols: List = []
+    types: List[ColType] = []
+    for column, field in zip(table.columns, table.schema):
+        arr = column.combine_chunks()
+        t = field.type
+        if pa.types.is_fixed_size_list(t):
+            width = t.list_size
+            flat = arr.values.to_numpy(zero_copy_only=False)
+            flat = _downcast(flat)
+            cols.append(flat.reshape(-1, width))
+            types.append(ColType(flat.dtype, shape=(width,)))
+        elif (pa.types.is_list(t) or pa.types.is_large_list(t)):
+            py = arr.to_pylist()
+            col = np.empty(len(py), dtype=object)
+            col[:] = py
+            cols.append(col)
+            types.append(ColType(np.dtype(object), tag="list"))
+        elif pa.types.is_string(t) or pa.types.is_large_string(t):
+            py = arr.to_pylist()
+            col = np.empty(len(py), dtype=object)
+            col[:] = py
+            cols.append(col)
+            types.append(ColType(np.dtype(object), tag="str"))
+        else:
+            npcol = _downcast(arr.to_numpy(zero_copy_only=False))
+            cols.append(npcol)
+            types.append(ColType(npcol.dtype))
+    return Frame(cols, Schema(types, prefix=prefix))
+
+
+def write_parquet(frame: Frame, url: str,
+                  names: Optional[Sequence[str]] = None) -> None:
+    """Write one frame as a parquet file at ``url`` (any fsspec
+    scheme, like the store tier)."""
+    _require_pyarrow()
+    import fsspec
+    import pyarrow.parquet as pq
+
+    table = to_arrow(frame, names=names)
+    with fsspec.open(url, "wb") as f:
+        pq.write_table(table, f)
+
+
+def read_parquet(url: str, columns: Optional[Sequence[str]] = None,
+                 prefix: Optional[int] = None,
+                 row_groups: Optional[Sequence[int]] = None) -> Frame:
+    """Read a parquet file at ``url`` into a Frame; ``row_groups``
+    selects a subset (the sharded-source unit, ops/parquet.py)."""
+    _require_pyarrow()
+    import fsspec
+    import pyarrow.parquet as pq
+
+    with fsspec.open(url, "rb") as f:
+        pf = pq.ParquetFile(f)
+        if row_groups is None:
+            table = pf.read(columns=list(columns) if columns else None)
+        else:
+            table = pf.read_row_groups(
+                list(row_groups),
+                columns=list(columns) if columns else None,
+            )
+    return from_arrow(table, prefix=prefix)
+
+
+def parquet_row_group_count(url: str) -> int:
+    _require_pyarrow()
+    import fsspec
+    import pyarrow.parquet as pq
+
+    with fsspec.open(url, "rb") as f:
+        return pq.ParquetFile(f).metadata.num_row_groups

@@ -1,0 +1,161 @@
+"""Arrow / Parquet interchange (frame/arrow.py, ops/parquet.py, and
+the Result conveniences): the columnar-ecosystem boundary the
+reference's flat-file readers occupy."""
+
+import numpy as np
+import pytest
+
+import bigslice_tpu as bs
+from bigslice_tpu.exec.session import Session
+from bigslice_tpu.frame import arrow
+from bigslice_tpu.frame.frame import Frame, obj_col
+from bigslice_tpu.slicetype import ColType, Schema
+
+
+def test_frame_arrow_roundtrip_all_column_kinds():
+    n = 20
+    rng = np.random.RandomState(0)
+    lists = np.empty(n, dtype=object)
+    lists[:] = [list(range(i % 4)) for i in range(n)]
+    f = Frame(
+        [
+            rng.randint(0, 99, n).astype(np.int32),
+            rng.rand(n).astype(np.float32),
+            rng.rand(n, 3).astype(np.float32),  # vector column
+            obj_col([f"w{i % 5}" for i in range(n)]),
+            lists,
+        ],
+        Schema(
+            [
+                ColType(np.int32),
+                ColType(np.float32),
+                ColType(np.float32, shape=(3,)),
+                ColType(np.dtype(object), tag="str"),
+                ColType(np.dtype(object), tag="list"),
+            ],
+            prefix=2,
+        ),
+    )
+    table = arrow.to_arrow(f)
+    assert table.num_rows == n and table.num_columns == 5
+    back = arrow.from_arrow(table)
+    assert back.prefix == 2  # metadata round-trips
+    assert [ct.tag for ct in back.schema] == \
+        [ct.tag for ct in f.schema]
+    for a, b in zip(f.cols, back.cols):
+        a, b = np.asarray(a), np.asarray(b)
+        if a.dtype == object:
+            assert [list(np.ravel(x)) if not isinstance(x, str) else x
+                    for x in a] == \
+                   [list(np.ravel(x)) if not isinstance(x, str) else x
+                    for x in b]
+        else:
+            np.testing.assert_array_equal(a, b)
+
+
+def test_from_arrow_downcasts_64bit_to_device_tier():
+    import pyarrow as pa
+
+    t = pa.table({
+        "k": pa.array([1, 2, 3], type=pa.int64()),
+        "v": pa.array([0.5, 1.5, 2.5], type=pa.float64()),
+    })
+    f = arrow.from_arrow(t, prefix=1)
+    assert f.cols[0].dtype == np.int32
+    assert f.cols[1].dtype == np.float32
+
+
+def test_to_arrow_refuses_arbitrary_objects():
+    col = np.empty(2, dtype=object)
+    col[:] = [object(), object()]
+    f = Frame([col], Schema([ColType(np.dtype(object))]))
+    with pytest.raises(Exception):
+        arrow.to_arrow(f)
+
+
+def test_parquet_reader_shards_row_groups(tmp_path):
+    """A multi-row-group parquet file reads round-robin across shards
+    and feeds an ordinary device pipeline."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    n = 300
+    rng = np.random.RandomState(1)
+    keys = rng.randint(0, 12, n).astype(np.int32)
+    vals = rng.randint(0, 9, n).astype(np.int32)
+    path = str(tmp_path / "in.parquet")
+    pq.write_table(
+        pa.table({"k": keys, "v": vals}), path, row_group_size=32
+    )
+    assert arrow.parquet_row_group_count(path) > 4
+
+    src = bs.ParquetReader(3, path, out=[np.int32, np.int32])
+    got = dict(Session().run(
+        bs.Reduce(src, lambda a, b: a + b)
+    ).rows())
+    oracle = {}
+    for k, v in zip(keys.tolist(), vals.tolist()):
+        oracle[k] = oracle.get(k, 0) + v
+    assert got == oracle
+
+
+def test_result_to_arrow_pandas_and_write_parquet(tmp_path):
+    sess = Session()
+    keys = np.arange(40, dtype=np.int32) % 5
+    res = sess.run(bs.Reduce(bs.Const(4, keys, np.ones(40, np.int32)),
+                             lambda a, b: a + b))
+    table = res.to_arrow(names=["key", "count"])
+    assert table.column_names == ["key", "count"]
+    df = res.to_pandas(names=["key", "count"])
+    assert dict(zip(df["key"], df["count"])) == {k: 8 for k in range(5)}
+
+    res.write_parquet(str(tmp_path / "out"), names=["key", "count"])
+    import glob
+
+    files = sorted(glob.glob(str(tmp_path / "out-*.parquet")))
+    assert len(files) == res.num_shards
+    total = {}
+    for p in files:
+        f = arrow.read_parquet(p)
+        for k, c in zip(np.asarray(f.cols[0]), np.asarray(f.cols[1])):
+            total[int(k)] = total.get(int(k), 0) + int(c)
+    assert total == {k: 8 for k in range(5)}
+
+
+def test_cogroup_result_to_arrow_ragged_lists(tmp_path):
+    """Ragged cogroup outputs interchange as Arrow List columns."""
+    keys = np.array([0, 1, 0, 2, 1, 0], np.int32)
+    vals = np.arange(6, dtype=np.int32)
+    res = Session().run(bs.Cogroup(bs.Const(2, keys, vals)))
+    table = res.to_arrow(names=["key", "vals"])
+    got = {int(k): sorted(v)
+           for k, v in zip(table["key"].to_pylist(),
+                           table["vals"].to_pylist())}
+    assert got == {0: [0, 2, 5], 1: [1, 4], 2: [3]}
+
+
+def test_empty_list_column_keeps_concrete_type():
+    """An all-empty (or zero-row) list column must not become Arrow
+    null type — empty shards of a cogroup result must unify with their
+    siblings and round-trip the list tag."""
+    import pyarrow as pa
+
+    empty = np.empty(0, dtype=object)
+    f = Frame([np.empty(0, np.int32), empty],
+              Schema([ColType(np.int32),
+                      ColType(np.dtype(object), tag="list")]))
+    t = arrow.to_arrow(f)
+    assert pa.types.is_list(t.schema.field(1).type)
+    back = arrow.from_arrow(t)
+    assert back.schema.cols[1].tag == "list"
+
+
+def test_from_arrow_downcasts_vector_columns_too():
+    import pyarrow as pa
+
+    flat = pa.array(np.arange(6, dtype=np.float64))
+    fsl = pa.FixedSizeListArray.from_arrays(flat, 2)
+    t = pa.Table.from_arrays([fsl], names=["m"])
+    f = arrow.from_arrow(t, prefix=1)
+    assert f.cols[0].dtype == np.float32
+    assert f.schema.cols[0].dtype == np.dtype(np.float32)  # schema too

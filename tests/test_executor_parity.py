@@ -84,7 +84,7 @@ def _normalize(name, rows):
 
     Group cells (cogroup lists / groupby vectors) sort their members —
     member order within a key is tier-dependent by contract."""
-    sort_members = name.startswith("cogroup")
+    sort_members = name.startswith(("cogroup", "groupby"))
     out = []
     for r in rows:
         canon = []
