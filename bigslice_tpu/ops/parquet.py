@@ -23,8 +23,10 @@ from bigslice_tpu.slicetype import Schema
 
 class ParquetReader(Slice):
     """``ParquetReader(num_shards, url, out=[...], prefix=1,
-    columns=None)`` — read one Parquet file's row groups round-robin
-    across shards."""
+    columns=None)`` — read Parquet across shards. ``url`` may be a
+    single file (row groups round-robin) or an fsspec glob
+    (``data/*.parquet``: whole files round-robin, so a shard never
+    reads a footer of a file it doesn't own)."""
 
     def __init__(self, num_shards: int, url: str, out, prefix: int = 1,
                  columns=None):
@@ -34,6 +36,27 @@ class ParquetReader(Slice):
         super().__init__(schema, num_shards, make_name("parquet"))
         self.url = url
         self.columns = list(columns) if columns is not None else None
+        # The file list is PINNED at graph-build time: per-shard
+        # listing at read time could see a mutating directory
+        # differently per shard and silently duplicate or drop files
+        # under the round-robin split. (Only '*' triggers expansion —
+        # '?'/'[' appear in presigned URLs and literal filenames.)
+        self.urls = self._expand(url)
+
+    @staticmethod
+    def _expand(url: str):
+        if "*" not in url:
+            return [url]
+        import fsspec
+
+        fs, _, paths = fsspec.get_fs_token_paths(url)
+        typecheck.check(bool(paths),
+                        "parquet: glob %r matched no files", url)
+        proto = fs.protocol if isinstance(fs.protocol, str) \
+            else fs.protocol[0]
+        if proto in ("file", "local"):
+            return sorted(paths)
+        return sorted(f"{proto}://{p}" for p in paths)
 
     def reader(self, shard, deps):
         def read():
@@ -42,24 +65,38 @@ class ParquetReader(Slice):
 
             from bigslice_tpu.frame import arrow
 
-            # One open + one footer parse per shard (a ParquetFile per
-            # row group would cost S + G footer round-trips on remote
-            # stores); groups stream one at a time for bounded memory.
-            with fsspec.open(self.url, "rb") as fh:
-                pf = pq.ParquetFile(fh)
-                mine = range(shard, pf.metadata.num_row_groups,
-                             self.num_shards)
-                for g in mine:
-                    f = arrow.from_arrow(
-                        pf.read_row_groups([g], columns=self.columns),
-                        prefix=self.schema.prefix,
-                    )
-                    typecheck.check(
-                        f.schema.assignable_to(self.schema),
-                        "parquet: file columns %s do not match the "
-                        "declared schema %s", f.schema, self.schema,
-                    )
-                    if len(f):
-                        yield f
+            # Single file: row groups round-robin. Many files: whole
+            # files round-robin, so a shard opens (and footer-parses)
+            # ONLY its own files — the remote-store-friendly split.
+            # Either way one ParquetFile per touched file; groups
+            # stream one at a time for bounded memory.
+            urls = self.urls
+            if len(urls) == 1:
+                plan = [(urls[0], None)]  # None => my groups within
+            else:
+                plan = [(u, "all") for i, u in enumerate(urls)
+                        if i % self.num_shards == shard]
+            for url, which in plan:
+                with fsspec.open(url, "rb") as fh:
+                    pf = pq.ParquetFile(fh)
+                    n_groups = pf.metadata.num_row_groups
+                    mine = (range(n_groups) if which == "all"
+                            else range(shard, n_groups,
+                                       self.num_shards))
+                    for g in mine:
+                        f = arrow.from_arrow(
+                            pf.read_row_groups(
+                                [g], columns=self.columns
+                            ),
+                            prefix=self.schema.prefix,
+                        )
+                        typecheck.check(
+                            f.schema.assignable_to(self.schema),
+                            "parquet: %s columns %s do not match the "
+                            "declared schema %s", url, f.schema,
+                            self.schema,
+                        )
+                        if len(f):
+                            yield f
 
         return read()

@@ -159,3 +159,36 @@ def test_from_arrow_downcasts_vector_columns_too():
     f = arrow.from_arrow(t, prefix=1)
     assert f.cols[0].dtype == np.float32
     assert f.schema.cols[0].dtype == np.dtype(np.float32)  # schema too
+
+
+def test_parquet_reader_glob_multi_file(tmp_path):
+    """A glob of parquet files splits whole files round-robin across
+    shards; the union covers every row exactly once."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    rng = np.random.RandomState(2)
+    oracle = {}
+    for i in range(5):
+        keys = rng.randint(0, 7, 60).astype(np.int32)
+        vals = np.ones(60, np.int32)
+        for k in keys.tolist():
+            oracle[k] = oracle.get(k, 0) + 1
+        pq.write_table(pa.table({"k": keys, "v": vals}),
+                       str(tmp_path / f"part{i}.parquet"),
+                       row_group_size=25)
+
+    src = bs.ParquetReader(3, str(tmp_path / "part*.parquet"),
+                           out=[np.int32, np.int32])
+    got = dict(Session().run(
+        bs.Reduce(src, lambda a, b: a + b)
+    ).rows())
+    assert got == oracle
+
+    from bigslice_tpu.typecheck import TypecheckError
+
+    with pytest.raises(TypecheckError, match="matched no files"):
+        bs.ParquetReader(
+            2, str(tmp_path / "nope*.parquet"),
+            out=[np.int32, np.int32],
+        )
