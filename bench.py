@@ -141,20 +141,23 @@ def reduce_kernel_bench(keys, vals, iters: int = 5):
 
 
 def reduce_e2e_bench(keys, vals, iters: int = 3, dense_keys=None,
-                     auto_dense: bool = True):
+                     auto_dense: bool = True, hash_aggregate=None):
     """End-to-end: Session + MeshExecutor + result scan, fresh slices
     per iteration (compile caches warm after iteration 0 — the
     iterative-driver steady state). ``dense_keys`` engages the
     sort-free dense-table lowering (parallel/dense.py) explicitly;
     with neither declared nor disabled, the executor's staging-time
     probe discovers dense ranges itself. ``auto_dense=False`` pins the
-    generic sort path for A/B."""
+    generic-key path (hash-aggregate by default; pass
+    ``hash_aggregate=False`` too for the pure sort-pipeline A/B)."""
     import bigslice_tpu as bs
     from bigslice_tpu.exec.meshexec import MeshExecutor
     from bigslice_tpu.exec.session import Session
 
     mesh = _mesh()
-    sess = Session(executor=MeshExecutor(mesh, auto_dense=auto_dense))
+    sess = Session(executor=MeshExecutor(
+        mesh, auto_dense=auto_dense, hash_aggregate=hash_aggregate
+    ))
     n = mesh.devices.size
 
     def add(a, b):
@@ -182,9 +185,29 @@ def reduce_e2e_bench(keys, vals, iters: int = 3, dense_keys=None,
     if sess.executor.device_group_count() == 0:
         raise RuntimeError("e2e reduce never engaged the device path")
     best = min(times)
+    # The pass count is the declared roofline risk (BASELINE.md): the
+    # hash-aggregate pipeline holds it at ~6 full-data passes (claim
+    # rounds + accumulate + one region a2a + receive-side cascade +
+    # compaction) vs ~12 for the sort pipeline. Printed AND asserted:
+    # if the generic path silently regressed to sorts (blacklist,
+    # classification drift), this bench fails loudly.
+    ex = sess.executor
+    generic = dense_keys is None and not auto_dense
+    hash_on = generic and ex._hashagg_enabled() and not ex._hash_off
+    passes = 12 if (generic and not hash_on) else 6
+    lowering = ("hash-aggregate" if hash_on
+                else "sort" if generic
+                else "dense" if dense_keys else "auto-dense")
+    note(f"reduce_e2e lowering: {lowering}; ~{passes} HBM passes")
+    if generic and ex._hashagg_enabled():
+        assert not ex._hash_off, (
+            "hash-aggregate path blacklisted mid-bench: "
+            f"{ex._hash_off}"
+        )
+        assert passes <= 6, passes
     note(f"reduce_e2e: {distinct} distinct keys, "
          f"device groups {sess.executor.device_group_count()}")
-    _bytes_roofline("reduce_e2e", len(keys), 8, best, passes=12)
+    _bytes_roofline("reduce_e2e", len(keys), 8, best, passes=passes)
     return len(keys) / best
 
 
@@ -642,19 +665,25 @@ def run_mode(mode: str, size, fallback: bool) -> None:
         base = cpu_reduce_baseline(keys, vals)
         dev = reduce_e2e_bench(keys, vals)
         emit("reduce_by_key_e2e_rows_per_sec", dev, "rows/sec", base)
-    elif mode == "reduce-sort":
-        # The generic-key sort pipeline, auto-discovery pinned off —
-        # the A/B partner for `reduce` and the number that stands for
-        # workloads whose keys genuinely aren't dense.
+    elif mode in ("reduce-sort", "reduce-nohash"):
+        # The generic-key pipeline, auto-discovery pinned off — the
+        # A/B partner for `reduce` and the number that stands for
+        # workloads whose keys genuinely aren't dense. Served by the
+        # hash-aggregate lowering where enabled; `reduce-nohash` pins
+        # that off too, measuring the pure sort pipeline for the
+        # BASELINE.md A/B record.
         n_rows = size or (1 << 21 if fallback else 1 << 24)
         n_keys = 1 << 16
         rng = np.random.RandomState(42)
         keys = rng.randint(0, n_keys, n_rows).astype(np.int32)
         vals = np.ones(n_rows, dtype=np.int32)
         base = cpu_reduce_baseline(keys, vals)
-        dev = reduce_e2e_bench(keys, vals, auto_dense=False)
-        emit("reduce_by_key_sort_e2e_rows_per_sec", dev, "rows/sec",
-             base)
+        dev = reduce_e2e_bench(
+            keys, vals, auto_dense=False,
+            hash_aggregate=False if mode == "reduce-nohash" else None,
+        )
+        emit(f"reduce_by_key_{'nohash' if mode == 'reduce-nohash' else 'sort'}"
+             f"_e2e_rows_per_sec", dev, "rows/sec", base)
     elif mode == "reduce-dense":
         # The same workload as `reduce` with the key space declared
         # (dense int32 codes in [0, 2^16)) — the sort-free
@@ -777,9 +806,10 @@ def main():
     # JSON line in bounded time.
     fallback = backend in ("cpu", "cpu-fallback")
     args = sys.argv[1:]
-    known = ("reduce", "reduce-sort", "reduce-dense", "reduce-kernel",
-             "join", "join-dense", "join-kernel", "wordcount",
-             "sortshuffle", "cogroup", "kmeans", "attention", "matrix")
+    known = ("reduce", "reduce-sort", "reduce-nohash", "reduce-dense",
+             "reduce-kernel", "join", "join-dense", "join-kernel",
+             "wordcount", "sortshuffle", "cogroup", "kmeans",
+             "attention", "matrix")
     mode = "matrix"
     if args and args[0] in known:
         mode = args.pop(0)

@@ -326,7 +326,8 @@ class MeshExecutor:
     def __init__(self, mesh, fallback_procs: Optional[int] = None,
                  ordered_dispatch: bool = False, spmd: bool = False,
                  auto_dense: bool = True,
-                 device_budget_bytes: Optional[int] = None):
+                 device_budget_bytes: Optional[int] = None,
+                 hash_aggregate: Optional[bool] = None):
         import os
 
         self.mesh = mesh
@@ -353,6 +354,24 @@ class MeshExecutor:
         # table+collective lowering without a dense_keys= annotation).
         # Off for A/B benchmarks of the generic sort path.
         self.auto_dense = auto_dense
+        # Open-addressed hash aggregation for generic (non-dense) keys
+        # with classified combine ops (parallel/hashagg.py — the
+        # combiningFrame analog, exec/combiner.go:56-99): replaces every
+        # sort in the Reduce/JoinAggregate pipeline with scatter/gather
+        # probing. Default: on everywhere except real TPU hardware,
+        # where large irregular scatters are the unproven primitive and
+        # the bitonic sort pipeline is the measured-safe default until a
+        # Mosaic hash-table kernel lands (BASELINE.md round-5 A/B shows
+        # the CPU-mesh gap: sorts are ~40x a scatter pass there).
+        if hash_aggregate is None:
+            env = os.environ.get("BIGSLICE_HASH_AGGREGATE")
+            if env:
+                hash_aggregate = env not in ("0", "false", "off")
+        self._use_hashagg = hash_aggregate
+        # Ops whose claim cascade overflowed (load factor ~1 /
+        # adversarial keys): permanently back on the sort path, which
+        # handles them without retries.
+        self._hash_off: set = set()
         # SPMD session mode: this executor is one of N identical
         # processes forming a global mesh (every process runs the same
         # driver program — SURVEY.md §7.1's Func-registry-by-
@@ -1433,6 +1452,15 @@ class MeshExecutor:
                     cur + int(np.asarray(overflow))
                 )
                 continue
+            if (int(np.asarray(overflow)) > 0
+                    and self._op_hash_engaged(task0, stages)):
+                # Hash-aggregate claim cascade failed (load factor ~1 /
+                # adversarial keys): the result is discarded and the op
+                # permanently rebuilds on the sort path, which handles
+                # any key distribution — NOT the slack ladder, which
+                # the hash lowering ignores.
+                self._hash_off.add(_op_base(task0.name.op))
+                continue
             if not has_shuffle or int(np.asarray(overflow)) == 0:
                 break
             # slack == ndest makes overflow impossible (a source can
@@ -1717,6 +1745,98 @@ class MeshExecutor:
             return None
         return None
 
+    # -- hash-aggregate gating --------------------------------------------
+
+    def _hashagg_enabled(self) -> bool:
+        if self._use_hashagg is None:
+            import jax
+
+            # Unproven primitive on real TPU hardware (see __init__
+            # rationale); everywhere else the scatter path wins by the
+            # BASELINE.md round-5 A/B.
+            self._use_hashagg = jax.default_backend() != "tpu"
+        return self._use_hashagg
+
+    def _hash_combine_ops(self, opbase: str, fc, schema):
+        """Classified per-column ops when the hash-aggregate lowering
+        may serve this combiner (combine or combiner-bearing shuffle
+        stage); None → the sort (or dense) path. ONE source of truth —
+        the program builder and the overflow-retry router both call
+        this, so they cannot disagree about which lowering ran."""
+        if not self._hashagg_enabled() or opbase in self._hash_off:
+            return None
+        if getattr(fc, "dense_keys", None) is not None:
+            # Declared/discovered dense bound: the rank-table lowering
+            # (or, when it gates itself off, the sort path that honors
+            # the badrange contract) takes precedence.
+            return None
+        for ct in schema.key:
+            if ct.dtype == np.dtype(object) or ct.shape:
+                return None
+        from bigslice_tpu.parallel.dense import classified_ops_cached
+
+        try:
+            return classified_ops_cached(
+                fc.fn, fc.nvals,
+                tuple(ct.dtype for ct in schema.values),
+                tuple(ct.shape for ct in schema.values),
+            )
+        except TypeError:  # unhashable fn object: lru_cache key fails
+            return None
+
+    def _hash_join_ops(self, opbase: str, s):
+        """(ops_a, ops_b) when the sortless hash join may serve this
+        join stage; None otherwise."""
+        if not self._hashagg_enabled() or opbase in self._hash_off:
+            return None
+        fcA, fcB = s.frame_combiners
+        if (getattr(fcA, "dense_keys", None) is not None
+                or getattr(fcB, "dense_keys", None) is not None):
+            return None
+        for ct in s.a.schema.key:
+            if ct.dtype == np.dtype(object) or ct.shape:
+                return None
+        from bigslice_tpu.parallel.dense import classified_ops_cached
+
+        try:
+            opsA = classified_ops_cached(
+                fcA.fn, fcA.nvals,
+                tuple(ct.dtype for ct in s.a.schema.values),
+                tuple(ct.shape for ct in s.a.schema.values),
+            )
+            opsB = classified_ops_cached(
+                fcB.fn, fcB.nvals,
+                tuple(ct.dtype for ct in s.b.schema.values),
+                tuple(ct.shape for ct in s.b.schema.values),
+            )
+        except TypeError:
+            return None
+        if opsA is None or opsB is None:
+            return None
+        return opsA, opsB
+
+    def _op_hash_engaged(self, task: Task, stages) -> bool:
+        """Would any stage of this op's program run a hash lowering
+        right now? Consulted by the wave retry loop to route an
+        overflow signal to the sort-path fallback instead of the
+        bucket-slack ladder."""
+        opbase = _op_base(task.name.op)
+        for kind, _, s in stages:
+            if kind == "combine":
+                if self._hash_combine_ops(
+                        opbase, s.frame_combiner, s.schema) is not None:
+                    return True
+            elif kind == "shuffle":
+                fc = s.partitioner.combiner
+                if (fc is not None and fc.nkeys == s.schema.prefix
+                        and self._hash_combine_ops(
+                            opbase, fc, s.schema) is not None):
+                    return True
+            elif kind == "join":
+                if self._hash_join_ops(opbase, s) is not None:
+                    return True
+        return False
+
     def _maybe_auto_dense(self, task0: Task, inputs, wave: int) -> None:
         """VERDICT r2 #5: a user with int32 categorical keys who does
         not pass dense_keys= should still get the table+collective
@@ -1903,9 +2023,13 @@ class MeshExecutor:
         stages = self._stages_for(task)
         if not subids:
             subids = tuple(False for _ in caps)
+        # The hash-eligibility bit keys the cache: a blacklisted op
+        # (claim-cascade overflow) must rebuild on the sort path even
+        # though every other key component is unchanged.
         key = (tuple((k, sid) for k, sid, _ in stages), caps,
                task.num_partition, len(task.schema),
-               self._input_ncols(task), slack, subids)
+               self._input_ncols(task), slack, subids,
+               self._op_hash_engaged(task, stages))
         # The key embeds id()s of stage functions, which can recycle after
         # GC; weakrefs to the actual function objects guard each entry
         # (the jitutil._VMAP_CACHE pattern) — a recycled id recompiles
@@ -1930,6 +2054,7 @@ class MeshExecutor:
 
         axis = mesh_axis(self.mesh)
         nmesh = self.nmesh
+        opbase = _op_base(task.name.op)
         shard_map = get_shard_map()
         n_extras = sum(
             len(s.args) for kind, _, s in stages if kind == "map"
@@ -1960,8 +2085,10 @@ class MeshExecutor:
             (A,B) adjacent pairs become output rows. Dense-declared
             joins skip both the reduces and the sort: rank-indexed
             scatter tables + an elementwise presence AND
-            (parallel/dense.make_dense_join). Returns
-            (mask, cols, bad)."""
+            (parallel/dense.make_dense_join); classified generic keys
+            skip them too via one shared claim cascade
+            (parallel/hashagg.make_hash_join_align). Returns
+            (mask, cols, bad, overflow)."""
             from bigslice_tpu.parallel.join import make_align
 
             fcA, fcB = s.frame_combiners
@@ -1990,7 +2117,17 @@ class MeshExecutor:
                 )
                 mask, cols, bad = djoin(masks[0], colsA, masks[1],
                                         colsB)
-                return mask, cols, bad
+                return mask, cols, bad, jnp.int32(0)
+            jops = self._hash_join_ops(opbase, s)
+            if jops is not None:
+                from bigslice_tpu.parallel import hashagg as hashagg_mod
+
+                align = hashagg_mod.make_hash_join_align(
+                    nk, jops[0], jops[1]
+                )
+                mask, cols, hov = align(masks[0], colsA, masks[1],
+                                        colsB)
+                return mask, cols, jnp.int32(0), lax.psum(hov, axis)
             coreA = segment.make_segmented_reduce_masked(
                 nk, fcA.nvals, segment.canonical_combine(fcA.fn, fcA.nvals)
             )
@@ -2004,7 +2141,7 @@ class MeshExecutor:
             mask, cols = make_align(nk, fcA.nvals, fcB.nvals)(
                 keepA, kA, vA, keepB, kB, vB
             )
-            return mask, cols, jnp.int32(0)
+            return mask, cols, jnp.int32(0), jnp.int32(0)
 
         def dense_gate(dk, key_col, mask, badrange):
             """Declared-dense bookkeeping shared by the combine and
@@ -2062,9 +2199,11 @@ class MeshExecutor:
             gbover = jnp.int32(0)
             run_stages = stages
             if stages and stages[0][0] == "join":
-                mask, cols, jbad = join_prelude(stages[0][2], masks,
-                                                col_sets)
+                mask, cols, jbad, jov = join_prelude(
+                    stages[0][2], masks, col_sets
+                )
                 badrange = badrange + jbad
+                overflow = overflow + jov
                 run_stages = stages[1:]
             elif stages and stages[0][0] == "cogroup":
                 # N-ary ragged grouping: one tagged sort over the
@@ -2186,6 +2325,7 @@ class MeshExecutor:
                         getattr(fc, "dense_keys", None), cols[0],
                         mask, badrange,
                     )
+                    hops = self._hash_combine_ops(opbase, fc, s.schema)
                     if use_dk is not None:
                         # Dense-coded keys: scatter-accumulate table
                         # instead of sort+segmented-scan.
@@ -2197,6 +2337,25 @@ class MeshExecutor:
                             use_dk, fc.dense_ops,
                             [ct.dtype for ct in s.schema.values],
                         )
+                    elif hops is not None:
+                        # Generic keys, classified ops: open-addressed
+                        # hash aggregation (parallel/hashagg.py) —
+                        # sortless; cascade failure rides the overflow
+                        # channel into the sort-path fallback.
+                        from bigslice_tpu.parallel import (
+                            hashagg as hashagg_mod,
+                        )
+
+                        core = hashagg_mod.make_hash_combine(
+                            fc.nkeys, fc.nvals, hops
+                        )
+                        mask, keys, vals, hov = core(
+                            mask, tuple(cols[: fc.nkeys]),
+                            tuple(cols[fc.nkeys :]),
+                        )
+                        overflow = overflow + lax.psum(hov, axis)
+                        cols = list(keys) + list(vals)
+                        continue
                     else:
                         core = segment.make_segmented_reduce_masked(
                             fc.nkeys, fc.nvals,
@@ -2283,6 +2442,25 @@ class MeshExecutor:
                         cols = list(cols)
                         overflow = overflow + ov
                         badrange = badrange + nb
+                    elif (fc is not None and fc.nkeys == nkeys
+                          and self._hash_combine_ops(
+                              opbase, fc, s.schema) is not None):
+                        # Generic keys, classified ops: sortless fused
+                        # combine+shuffle — the aggregation table is
+                        # destination-contiguous, so the exchange is one
+                        # all_to_all of table regions
+                        # (parallel/hashagg.py).
+                        from bigslice_tpu.parallel import (
+                            hashagg as hashagg_mod,
+                        )
+
+                        body = hashagg_mod.make_hash_combine_shuffle(
+                            nmesh, fc.nkeys, fc.nvals,
+                            self._hash_combine_ops(opbase, fc, s.schema),
+                            axis, partition_fn=pfn,
+                            nparts=s.num_partition,
+                        )
+                        mask, ov, nb, cols = body.masked(mask, *cols)
                     elif fc is not None and fc.nkeys == nkeys:
                         # Combiner-bearing shuffle: the fused kernel's
                         # single (validity, dest, keys) sort replaces
