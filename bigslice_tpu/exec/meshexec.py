@@ -1398,7 +1398,8 @@ class MeshExecutor:
                 for kind, _, s in stages if kind == "map"
                 for a in s.args
             ]
-            out_counts, overflow, badrange, gbover, out_cols = program(
+            (out_counts, overflow, badrange, gbover, hashov,
+             out_cols) = program(
                 np.int32(wave), *counts_list, *cols_flat, *extras
             )
             has_shuffle = any(k == "shuffle" for k, _, _ in stages)
@@ -1452,8 +1453,7 @@ class MeshExecutor:
                     cur + int(np.asarray(overflow))
                 )
                 continue
-            if (int(np.asarray(overflow)) > 0
-                    and self._op_hash_engaged(task0, stages)):
+            if int(np.asarray(hashov)) > 0:
                 # Hash-aggregate claim cascade failed (load factor ~1 /
                 # adversarial keys): the result is discarded and the op
                 # permanently rebuilds on the sort path, which handles
@@ -1786,31 +1786,11 @@ class MeshExecutor:
 
     def _hash_join_ops(self, opbase: str, s):
         """(ops_a, ops_b) when the sortless hash join may serve this
-        join stage; None otherwise."""
-        if not self._hashagg_enabled() or opbase in self._hash_off:
-            return None
+        join stage; None otherwise. One gate per side — the SAME gate
+        the combine/shuffle stages use, so eligibility can't drift."""
         fcA, fcB = s.frame_combiners
-        if (getattr(fcA, "dense_keys", None) is not None
-                or getattr(fcB, "dense_keys", None) is not None):
-            return None
-        for ct in s.a.schema.key:
-            if ct.dtype == np.dtype(object) or ct.shape:
-                return None
-        from bigslice_tpu.parallel.dense import classified_ops_cached
-
-        try:
-            opsA = classified_ops_cached(
-                fcA.fn, fcA.nvals,
-                tuple(ct.dtype for ct in s.a.schema.values),
-                tuple(ct.shape for ct in s.a.schema.values),
-            )
-            opsB = classified_ops_cached(
-                fcB.fn, fcB.nvals,
-                tuple(ct.dtype for ct in s.b.schema.values),
-                tuple(ct.shape for ct in s.b.schema.values),
-            )
-        except TypeError:
-            return None
+        opsA = self._hash_combine_ops(opbase, fcA, s.a.schema)
+        opsB = self._hash_combine_ops(opbase, fcB, s.b.schema)
         if opsA is None or opsB is None:
             return None
         return opsA, opsB
@@ -2197,13 +2177,17 @@ class MeshExecutor:
             # sharing badrange would let the auto-dense retraction eat a
             # real overflow (and mislabel dense-range errors as capacity).
             gbover = jnp.int32(0)
+            # Hash-aggregate cascade failure rides its OWN channel so
+            # the retry loop never confuses it with bucket-slack skew
+            # or cogroup capacity deficits (which share `overflow`).
+            hashov = jnp.int32(0)
             run_stages = stages
             if stages and stages[0][0] == "join":
                 mask, cols, jbad, jov = join_prelude(
                     stages[0][2], masks, col_sets
                 )
                 badrange = badrange + jbad
-                overflow = overflow + jov
+                hashov = hashov + jov
                 run_stages = stages[1:]
             elif stages and stages[0][0] == "cogroup":
                 # N-ary ragged grouping: one tagged sort over the
@@ -2353,7 +2337,7 @@ class MeshExecutor:
                             mask, tuple(cols[: fc.nkeys]),
                             tuple(cols[fc.nkeys :]),
                         )
-                        overflow = overflow + lax.psum(hov, axis)
+                        hashov = hashov + lax.psum(hov, axis)
                         cols = list(keys) + list(vals)
                         continue
                     else:
@@ -2460,7 +2444,9 @@ class MeshExecutor:
                             axis, partition_fn=pfn,
                             nparts=s.num_partition,
                         )
-                        mask, ov, nb, cols = body.masked(mask, *cols)
+                        mask, h_ov, nb, cols = body.masked(mask, *cols)
+                        hashov = hashov + h_ov
+                        ov = jnp.int32(0)
                     elif fc is not None and fc.nkeys == nkeys:
                         # Combiner-bearing shuffle: the fused kernel's
                         # single (validity, dest, keys) sort replaces
@@ -2497,11 +2483,12 @@ class MeshExecutor:
             if not mask_dirty:
                 # Map-only single-input chain: counts pass through.
                 return (jnp.asarray(counts_list[0][0]).reshape(1),
-                        overflow, badrange, gbover, tuple(cols))
+                        overflow, badrange, gbover, hashov,
+                        tuple(cols))
             # Final compaction to the front-packed (cols, count) contract.
             out_n, cols = segment.compact_by_mask(mask, cols)
             return (out_n.reshape(1), overflow, badrange, gbover,
-                    tuple(cols))
+                    hashov, tuple(cols))
 
         if stages and stages[0][0] == "cogroup":
             # Device view of the ragged output: keys, then per input
@@ -2518,7 +2505,7 @@ class MeshExecutor:
             + tuple(col_spec for _ in range(sum(in_ncols)))
             + tuple(P() for _ in range(n_extras))
         )
-        out_specs = (P(axis), P(), P(), P(),
+        out_specs = (P(axis), P(), P(), P(), P(),
                      tuple(col_spec for _ in range(ncols_out)))
         prog = jax.jit(
             shard_map(stepped, mesh=self.mesh, in_specs=in_specs,

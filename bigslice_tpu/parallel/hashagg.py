@@ -311,7 +311,7 @@ def make_hash_combine_shuffle(nmesh: int, nkeys: int, nvals: int,
                 planes = planes.swapaxes(0, 1)
                 planes = planes.reshape((nmesh, W * R) + x.shape[1:])
             recv = lax.all_to_all(planes, axis, 0, 0, tiled=False)
-            return recv.reshape((nmesh * W * R,) + x.shape[2:])
+            return recv.reshape((nmesh * W * R,) + x.shape[1:])
 
         recv_mask = route(present)
         out_cols = [route(c) for c in list(ok) + list(ovs)]

@@ -270,6 +270,42 @@ def test_hash_declines_general_combine_fn(monkeypatch):
     assert got == ref
 
 
+def test_hash_shuffle_vector_value_columns():
+    """Vector value columns ([n, d] rows — the k-means point-sum shape)
+    ride the hash combine+shuffle intact (round-5 reshape regression)."""
+    import jax.numpy as jnp
+
+    from bigslice_tpu.parallel import hashagg, segment
+
+    n, d = 1 << 10, 4
+    rng = np.random.RandomState(23)
+    keys = rng.randint(0, 128, 8 * n).astype(np.int32)
+    vecs = rng.randint(0, 10, (8 * n, d)).astype(np.int32)
+    fused = hashagg.make_hash_combine_shuffle(8, 1, 1, ("add",),
+                                              "shards")
+    recv = hashagg.make_hash_combine(1, 1, ("add",))
+
+    def body(k, v):
+        valid = jnp.ones(n, bool)
+        rm, ov, bad, oc = fused.masked(valid, k, v)
+        m2, k2, v2, ov2 = recv(rm, (oc[0],), (oc[1],))
+        cnt, packed = segment.compact_by_mask(m2, tuple(k2) + tuple(v2))
+        return cnt.reshape(1), (ov + ov2).reshape(1), packed[0], packed[1]
+
+    cnt, over, ko, vo = _shardmap_call(body, 4, keys, vecs)
+    assert int(over.sum()) == 0
+    size = len(ko) // 8
+    got = {}
+    for dev in range(8):
+        c = int(cnt[dev])
+        for i in range(dev * size, dev * size + c):
+            got[int(ko[i])] = vo[i].tolist()
+    ref = collections.defaultdict(lambda: np.zeros(d, np.int64))
+    for k, v in zip(keys, vecs):
+        ref[int(k)] += v
+    assert got == {k: v.tolist() for k, v in ref.items()}
+
+
 def test_e2e_join_hash_path_matches_local():
     n_rows = 1 << 13
     rng = np.random.RandomState(19)
