@@ -2427,8 +2427,8 @@ class MeshExecutor:
                         overflow = overflow + ov
                         badrange = badrange + nb
                     elif (fc is not None and fc.nkeys == nkeys
-                          and self._hash_combine_ops(
-                              opbase, fc, s.schema) is not None):
+                          and (shops := self._hash_combine_ops(
+                              opbase, fc, s.schema)) is not None):
                         # Generic keys, classified ops: sortless fused
                         # combine+shuffle — the aggregation table is
                         # destination-contiguous, so the exchange is one
@@ -2439,8 +2439,7 @@ class MeshExecutor:
                         )
 
                         body = hashagg_mod.make_hash_combine_shuffle(
-                            nmesh, fc.nkeys, fc.nvals,
-                            self._hash_combine_ops(opbase, fc, s.schema),
+                            nmesh, fc.nkeys, fc.nvals, shops,
                             axis, partition_fn=pfn,
                             nparts=s.num_partition,
                         )

@@ -109,10 +109,17 @@ def kmeans(sess, points: np.ndarray, k: int, iters: int = 10,
 
 def _assign_vec(x, c):
     """Per-row nearest-centroid assignment: x is the row's [d] point
-    vector, c the unbatched [k, d] centroid matrix."""
+    vector, c the unbatched [k, d] centroid matrix.
+
+    Written as the ‖c‖² − 2c·x rank expansion (matching kmeans_step):
+    under the executor's vmap the c·x matvec batches into the
+    [n,d]×[d,k] matmul — the MXU form — where the naive
+    ‖c − x‖² broadcast would lower to an [n,k,d] elementwise reduction
+    (3x the FLOPs, no matmul, and the round-4 bench's 0.26x gap)."""
     import jax.numpy as jnp
 
-    d2 = jnp.sum((c - x[None, :]) ** 2, axis=1)
+    c2 = jnp.sum(c * c, axis=1)
+    d2 = c2 - 2.0 * jnp.dot(c, x)
     return (jnp.argmin(d2).astype(jnp.int32), x, jnp.float32(1.0))
 
 
