@@ -815,6 +815,15 @@ def run_matrix(fallback: bool) -> None:
 
 
 def main():
+    if "--aot-check" in sys.argv[1:]:
+        # AOT-compile the whole device tier for a real TPU topology —
+        # no chip needed (tools/aotcheck.py); writes AOT_TPU.json.
+        from bigslice_tpu.tools import aotcheck
+
+        rest = [a for a in sys.argv[1:] if a != "--aot-check"]
+        aotcheck.main(rest)
+        return
+
     from bigslice_tpu.utils.hermetic import ensure_usable_backend
 
     backend = ensure_usable_backend()
