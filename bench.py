@@ -360,12 +360,10 @@ def wordcount_bench(n_rows: int, iters: int = 2):
     sess = _mesh_session(mesh)
     n = mesh.devices.size
 
-    def source():
-        # ScanReader contract: a no-arg line iterator; shards stripe it.
-        yield from lines
-
     def run_once():
-        return len(domain_count_encoded(sess, n, source))
+        # Sequence source: shards stripe by random access instead of
+        # each re-scanning the whole generator (ops/source.py).
+        return len(domain_count_encoded(sess, n, lines))
 
     run_once()
     times = []

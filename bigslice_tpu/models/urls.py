@@ -16,6 +16,7 @@ from typing import Callable, List, Tuple, Union
 import numpy as np
 
 import bigslice_tpu as bs
+from bigslice_tpu.frame import strparse
 
 
 def _domain(url: str) -> str:
@@ -71,11 +72,13 @@ def domain_count_encoded(sess, num_shards: int,
     # Pass 1 — ONE host sweep: parse, build the vocabulary, and encode
     # in the same batch fn; the materialized corpus is int32 CODES, so
     # everything downstream (count attach, hash, shuffle, combine) is
-    # device-tier. (Earlier shapes parsed twice and re-read host
-    # strings; the host sweep is this config's Amdahl term, so it runs
-    # exactly once.)
+    # device-tier. The sweep itself is vectorized byte-level span
+    # extraction + Arrow dictionary_encode (frame/strparse.py) — zero
+    # per-row Python for ASCII rows; _domains_batch remains the exact
+    # fallback (and the equivalence oracle in tests).
     def parse_encode(f):
-        return (vocab.encode_extending(_domains_batch(f.cols[0])),)
+        return (strparse.domains_codes(f.cols[0], vocab,
+                                       fallback_fn=_domain),)
 
     corpus = sess.run(bs.MapBatches(lines, parse_encode, out=[np.int32]))
     try:

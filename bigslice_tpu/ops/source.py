@@ -8,7 +8,7 @@ ScanReader (scan.go:16-58).
 
 from __future__ import annotations
 
-from typing import Callable, Iterable, Optional, Union
+from typing import Callable, Iterable, Optional, Sequence, Union
 
 import numpy as np
 
@@ -77,19 +77,34 @@ class WriterFunc(Slice):
         return read()
 
 
+# Per-frame rows for random-access (sequence) sources; see read_seq.
+SEQ_CHUNK_ROWS = 1 << 16
+
+
 class ScanReader(Slice):
     """Line-oriented text source (mirrors bigslice.ScanReader, scan.go:16-58):
     every shard scans the whole input, keeping lines ``i % num_shards ==
-    shard`` — simple, deterministic striping with no index."""
+    shard`` — simple, deterministic striping with no index.
 
-    def __init__(self, num_shards: int, source: Union[str, Callable]):
+    Sequence sources (list / ndarray of lines) stripe by random access
+    (``source[shard::ns]``) — same rows per shard, without each shard
+    re-iterating the whole input (an N-shard run over a generator
+    source costs N full scans, the faithful scan.go semantics; a
+    materialized corpus shouldn't pay that)."""
+
+    def __init__(self, num_shards: int,
+                 source: Union[str, Callable, Sequence]):
         typecheck.check(num_shards >= 1, "scanreader: num_shards must be >= 1")
         super().__init__(Schema([str], prefix=1), num_shards,
                          make_name("scanreader"))
         self.source = source
 
     def _lines(self):
-        if callable(self.source):
+        import numpy as _np
+
+        if isinstance(self.source, (list, tuple, _np.ndarray)):
+            yield from self.source
+        elif callable(self.source):
             yield from self.source()
         else:
             with open(self.source, "r") as fp:
@@ -101,6 +116,19 @@ class ScanReader(Slice):
 
         def frame_of(lines):
             return Frame([obj_col(lines)], self.schema)
+
+        def read_seq(seq):
+            # Materialized sources batch big: downstream vectorized
+            # parses (frame/strparse.py) amortize per-batch overhead
+            # and can engage the multi-core parse pool, which the
+            # streaming chunk size is too small to feed.
+            step = max(sliceio.DEFAULT_CHUNK_ROWS, SEQ_CHUNK_ROWS)
+            ns = self.num_shards
+            mine = seq[shard::ns] if ns > 1 else seq
+            for i in range(0, len(mine), step):
+                batch = list(mine[i : i + step])
+                if batch:
+                    yield frame_of(batch)
 
         def read():
             import itertools
@@ -118,4 +146,8 @@ class ScanReader(Slice):
                     return
                 yield frame_of(batch)
 
+        import numpy as _np
+
+        if isinstance(self.source, (list, tuple, _np.ndarray)):
+            return read_seq(self.source)
         return read()
