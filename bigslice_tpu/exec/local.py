@@ -100,6 +100,19 @@ class LocalExecutor:
     # sessions reuse threads; quiet executors shed them).
     WORKER_IDLE_SECS = 10.0
 
+    def resource_stats(self) -> dict:
+        """Host-tier resource telemetry (status/debug): RSS plus the
+        proc-limiter occupancy — the exec/slicemachine.go:238-257 role
+        for the in-process executor."""
+        from bigslice_tpu.utils import resources as resources_mod
+
+        return {
+            "host_rss_bytes": resources_mod.host_rss_bytes(),
+            "gauges": {
+                "procs": self.procs,
+            },
+        }
+
     def __init__(self, procs: Optional[int] = None,
                  store: Optional[store_mod.Store] = None):
         self.procs = procs or os.cpu_count() or 4

@@ -736,6 +736,38 @@ class MeshExecutor:
         with self._lock:
             return len(self._outputs)
 
+    def resource_stats(self) -> dict:
+        """Live resource telemetry for status/debug (round-5 verdict
+        #6): per-device HBM from the XLA allocator (real on TPU; the
+        virtual-CPU mesh reports none), host RSS, the executor's own
+        device-resident output accounting, and the combiner/shuffle
+        gauges (slack adaptations, budget split runs, hash-path
+        blacklist) — the exec/combiner.go:24-29 /
+        exec/slicemachine.go:238-257 analog."""
+        from bigslice_tpu.utils import resources as resources_mod
+
+        with self._lock:
+            outs = list(self._outputs.values())
+            gauges = {
+                "shuffle_slack": dict(self._slack_memo),
+                "split_runs": dict(self.split_runs),
+                "hash_off": sorted(self._hash_off),
+                "cogroup_caps": dict(self._cogroup_caps),
+                "device_groups": len(self._outputs),
+            }
+        resident = 0
+        for o in outs:
+            for c in getattr(o, "cols", ()) or ():
+                resident += int(getattr(c, "nbytes", 0) or 0)
+        return {
+            "host_rss_bytes": resources_mod.host_rss_bytes(),
+            "resident_output_bytes": resident,
+            "devices": resources_mod.device_memory(
+                list(self.mesh.devices.flat)
+            ),
+            "gauges": gauges,
+        }
+
     def resize(self, mesh) -> List[Task]:
         """Elasticity (SURVEY §5.3's TPU mapping (c); the analog of the
         reference's demand-driven capacity, exec/slicemachine.go:586-601,

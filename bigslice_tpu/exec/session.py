@@ -250,6 +250,9 @@ class Session:
         self.trace_path = trace_path
         self.tracer = trace_mod.Tracer() if trace_path else None
         self.status = status_mod.Status()
+        stats_fn = getattr(self.executor, "resource_stats", None)
+        if stats_fn is not None:
+            self.status.set_resources_provider(stats_fn)
         self._printer = None
         if status:
             self._printer = status_mod.StatusPrinter(self.status)

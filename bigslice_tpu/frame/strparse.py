@@ -86,23 +86,29 @@ def _domains_encoded(blob_b: bytes, n: int):
     return pc.dictionary_encode(pc.utf8_rtrim(heads, "\n"))
 
 
-def _merge_codes(enc, vocab) -> np.ndarray:
-    """DictionaryArray → global-vocab int32 codes; only the batch's
-    unique values touch Python.
+def _merge_codes_raw(indices: np.ndarray, batch_vocab: list,
+                     vocab) -> np.ndarray:
+    """Batch dictionary → global-vocab int32 codes; only the batch's
+    unique values touch Python. ONE implementation for the single- and
+    pool-path merges so the quarantine below can't diverge.
 
     Non-ASCII dictionary values are QUARANTINED (code -1, never
     entered into the vocab): the byte-level lower mangles multibyte
     case, and every row that can map to such a value is re-parsed by
     _fix_nonascii anyway — entering them would permanently pollute the
     vocabulary (and inflate dense_keys=len(vocab) reduces)."""
-    batch_vocab = enc.dictionary.to_pylist()
     ascii_mask = np.fromiter((v.isascii() for v in batch_vocab),
                              bool, len(batch_vocab))
     remap = np.full(len(batch_vocab), -1, np.int32)
     if ascii_mask.any():
         keep = np.array(batch_vocab, dtype=object)[ascii_mask]
         remap[ascii_mask] = vocab.encode_extending(keep)
-    return remap[enc.indices.to_numpy()].astype(np.int32)
+    return remap[indices].astype(np.int32)
+
+
+def _merge_codes(enc, vocab) -> np.ndarray:
+    return _merge_codes_raw(enc.indices.to_numpy(),
+                            enc.dictionary.to_pylist(), vocab)
 
 
 def _fix_nonascii(joined: bytes, lines, codes, vocab,
@@ -158,6 +164,7 @@ def domains_codes_single(lines: Sequence, vocab,
 
 _POOL = None
 _POOL_PROCS = 0
+_POOL_LOCK = None
 
 
 def parse_procs() -> int:
@@ -177,24 +184,41 @@ def _pool():
     deadlock. Workers only import numpy/pyarrow (~1s once per pool,
     amortized across the corpus). The pool is terminated at interpreter
     exit and whenever the proc count changes."""
-    global _POOL, _POOL_PROCS
+    global _POOL, _POOL_PROCS, _POOL_LOCK
     procs = parse_procs()
     if procs < 2:
         return None
-    if _POOL is None or _POOL_PROCS != procs:
-        import atexit
-        import multiprocessing as mp
+    if _POOL_LOCK is None:
+        import threading
 
-        shutdown_pool()
-        ctx = mp.get_context("spawn")
-        _POOL = ctx.Pool(procs)
-        _POOL_PROCS = procs
-        atexit.register(shutdown_pool)
-    return _POOL
+        _POOL_LOCK = threading.Lock()
+    # Locked check-then-create: executor worker threads parse shards
+    # concurrently, and a race here would leak a whole spawned pool.
+    with _POOL_LOCK:
+        if _POOL is None or _POOL_PROCS != procs:
+            import atexit
+            import multiprocessing as mp
+
+            _shutdown_pool_locked()
+            ctx = mp.get_context("spawn")
+            _POOL = ctx.Pool(procs)
+            _POOL_PROCS = procs
+            atexit.register(shutdown_pool)
+        return _POOL
 
 
 def shutdown_pool() -> None:
     """Terminate the shared parse pool (idempotent)."""
+    global _POOL_LOCK
+    if _POOL_LOCK is None:
+        import threading
+
+        _POOL_LOCK = threading.Lock()
+    with _POOL_LOCK:
+        _shutdown_pool_locked()
+
+
+def _shutdown_pool_locked() -> None:
     global _POOL, _POOL_PROCS
     if _POOL is not None:
         _POOL.terminate()
@@ -242,10 +266,7 @@ def domains_codes(lines: Sequence, vocab,
             )
         else:
             indices, batch_vocab = res
-            remap = vocab.encode_extending(
-                np.array(batch_vocab, dtype=object)
-            )
-            codes = remap[indices].astype(np.int32)
+            codes = _merge_codes_raw(indices, batch_vocab, vocab)
             _fix_nonascii(joined, ch, codes, vocab, fallback_fn)
             out[pos : pos + len(ch)] = codes
         pos += len(ch)

@@ -41,15 +41,20 @@ def _as_schema(out, default_prefix: int = 1) -> Schema:
     return Schema(cols, prefix=min(default_prefix, len(cols)))
 
 
-def _try_trace(fn: Callable, in_schema: Schema, extra: tuple = ()):
+def _try_trace(fn: Callable, in_schema: Schema, extra: tuple = (),
+               why: list = None):
     """Attempt an abstract trace of fn over scalar avals of the input
-    columns (plus unbatched ``extra`` args). Returns the output Schema or
-    None if fn is not traceable."""
+    columns (plus unbatched ``extra`` args). Returns the output Schema
+    or None if fn must run host-tier; when ``why`` is passed, a reason
+    string is appended on None returns that aren't plain
+    untraceability."""
     if not all(ct.is_device for ct in in_schema):
         return None
     try:
         import jax
         import jax.numpy as jnp
+
+        from bigslice_tpu.utils import metrics as metrics_mod
 
         # Per-row avals carry each column's trailing shape (vector
         # columns, e.g. GroupByKey matrices, present as [G] per row).
@@ -57,7 +62,22 @@ def _try_trace(fn: Callable, in_schema: Schema, extra: tuple = ()):
                  for ct in in_schema]
         especs = [jax.ShapeDtypeStruct(jnp.shape(e), jnp.asarray(e).dtype)
                   for e in extra]
-        out = jax.eval_shape(fn, *(specs + especs))
+        # Metrics probe: a counter touched during the trace means the
+        # fn must run host-tier, where per-record increments are real
+        # (a traced incr would count compiles, not rows). Data-
+        # DEPENDENT increments can't reach here — branching on a
+        # tracer raises and classifies host already.
+        probe = metrics_mod.TraceProbe()
+        with metrics_mod.scope_context(probe):
+            out = jax.eval_shape(fn, *(specs + especs))
+        if probe.touched:
+            if why is not None:
+                why.append(
+                    "function increments metrics counters, which only "
+                    "count correctly on the host tier (a traced incr "
+                    "runs per compile, not per row)"
+                )
+            return None
         if not isinstance(out, (tuple, list)):
             out = (out,)
         cols = [
@@ -135,8 +155,9 @@ class Map(_Pipelined):
         self.mode = mode
         self.args = tuple(args)
         traced = None
+        why: list = []
         if mode in ("auto", "jax"):
-            traced = _try_trace(fn, slice_.schema, self.args)
+            traced = _try_trace(fn, slice_.schema, self.args, why=why)
         if traced is not None:
             self.mode = "jax"
             if out is None:
@@ -185,12 +206,15 @@ class Map(_Pipelined):
         else:
             if mode == "jax":
                 raise typecheck.errorf(
-                    "map: function is not jax-traceable over %s",
-                    slice_.schema,
+                    "map: %s",
+                    why[0] if why else
+                    f"function is not jax-traceable over {slice_.schema}",
                 )
             if out is None:
                 raise typecheck.errorf(
-                    "map: host-mode function requires out= column types"
+                    "map: host-mode function requires out= column "
+                    "types%s",
+                    f" ({why[0]})" if why else "",
                 )
             self.mode = "host"
             schema = _as_schema(out)

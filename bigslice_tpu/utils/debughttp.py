@@ -34,6 +34,8 @@ class DebugServer:
                         "/debug/status  live task-state counts\n"
                         "/debug/tasks   task DAG (json)\n"
                         "/debug/trace   chrome trace (json)\n"
+                        "/debug/resources  HBM/RSS/combiner gauges "
+                        "(json)\n"
                     )
                     self._send(200, "text/plain", body)
                 elif self.path == "/debug/status":
@@ -42,6 +44,13 @@ class DebugServer:
                 elif self.path == "/debug/tasks":
                     self._send(200, "application/json",
                                json.dumps(server.task_graph()))
+                elif self.path == "/debug/resources":
+                    stats_fn = getattr(
+                        server.session.executor, "resource_stats", None
+                    )
+                    stats = stats_fn() if stats_fn is not None else {}
+                    self._send(200, "application/json",
+                               json.dumps(stats))
                 elif self.path == "/debug/trace":
                     tracer = server.session.tracer
                     events = tracer.events() if tracer else []

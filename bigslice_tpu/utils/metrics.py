@@ -91,6 +91,24 @@ def current_scope() -> Optional[Scope]:
     return _current.get()
 
 
+class TraceProbe(Scope):
+    """Scope installed during jax-classification traces (ops/mapops.py
+    _try_trace): records that user code touched metrics so the
+    combinator refuses the device tier for it. A counter incremented
+    inside a traced function would execute at TRACE time — once per
+    compile, not once per row — which is silently wrong; forcing such
+    functions onto the host tier keeps reference semantics (per-record
+    counts merged task → session, metrics/scope.go:17-152) at host-tier
+    speed."""
+
+    def __init__(self):
+        super().__init__()
+        self.touched = False
+
+    def incr(self, counter: Counter, n: int = 1) -> None:
+        self.touched = True
+
+
 class scope_context:
     """Context manager installing a scope for user-function calls."""
 

@@ -1,0 +1,115 @@
+"""Resource telemetry: per-device HBM and host memory gauges.
+
+The reference polls per-machine mem/disk/load into live status lines
+(exec/slicemachine.go:238-257, exec/bigmachine.go:457-477). The TPU
+analog's first-order signals are per-device HBM pressure — the input to
+the executor's budget-splitting path (exec/meshexec.py) — and host RSS:
+
+- ``device_memory()``: XLA's per-device allocator stats
+  (``bytes_in_use`` / ``bytes_limit``) where the backend reports them
+  (TPU does; virtual CPU devices return None and are skipped).
+- ``host_rss_bytes()``: current resident set from /proc (Linux), with
+  a getrusage fallback.
+
+Executors expose ``resource_stats()`` combining these with their own
+gauges (resident output bytes, adapted shuffle slack, split runs —
+the combiner instrumentation of exec/combiner.go:24-29); the status
+renderer and /debug/resources surface them live.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+
+def host_rss_bytes() -> Optional[int]:
+    """Current resident set size in bytes (None if unknowable)."""
+    try:
+        with open("/proc/self/statm") as fp:
+            pages = int(fp.read().split()[1])
+        import os
+
+        return pages * os.sysconf("SC_PAGE_SIZE")
+    except Exception:  # pragma: no cover - non-Linux fallback
+        try:
+            import resource
+
+            # ru_maxrss is KiB on Linux, bytes on macOS; either way a
+            # peak, not current — better than nothing.
+            import sys
+
+            v = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+            return v if sys.platform == "darwin" else v * 1024
+        except Exception:
+            return None
+
+
+def device_memory(devices=None) -> List[Dict]:
+    """Per-device allocator stats where the backend reports them.
+    Returns [] when no device does (virtual CPU meshes)."""
+    import jax
+
+    out = []
+    for d in devices if devices is not None else jax.devices():
+        try:
+            stats = d.memory_stats()
+        except Exception:  # pragma: no cover - backend quirks
+            stats = None
+        if not stats:
+            continue
+        out.append({
+            "id": int(d.id),
+            "kind": str(getattr(d, "device_kind", "")),
+            "bytes_in_use": stats.get("bytes_in_use"),
+            "bytes_limit": stats.get("bytes_limit"),
+            "peak_bytes_in_use": stats.get("peak_bytes_in_use"),
+        })
+    return out
+
+
+def _fmt_bytes(n: Optional[int]) -> str:
+    if n is None:
+        return "?"
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(n) < 1024:
+            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024.0
+    return f"{n:.1f}PB"
+
+
+def render_stats(stats: Dict) -> List[str]:
+    """Status lines for an executor's resource_stats() dict."""
+    lines = []
+    rss = stats.get("host_rss_bytes")
+    if rss is not None:
+        lines.append(f"  host rss: {_fmt_bytes(rss)}")
+    resident = stats.get("resident_output_bytes")
+    if resident is not None:
+        lines.append(
+            f"  device-resident outputs: {_fmt_bytes(resident)}"
+        )
+    for d in stats.get("devices", ()):
+        use, lim = d.get("bytes_in_use"), d.get("bytes_limit")
+        pct = (f" ({100.0 * use / lim:.0f}%)"
+               if use is not None and lim else "")
+        lines.append(
+            f"  device {d['id']}: {_fmt_bytes(use)}/{_fmt_bytes(lim)}"
+            f" HBM in use{pct}"
+        )
+    g = stats.get("gauges") or {}
+    slack = g.get("shuffle_slack")
+    if slack:
+        worst = ", ".join(f"{op}={v:g}" for op, v in
+                          sorted(slack.items())[:4])
+        lines.append(f"  shuffle slack adaptations: {worst}")
+    splits = g.get("split_runs")
+    if splits:
+        s = ", ".join(f"{op}x{k}" for op, k in
+                      sorted(splits.items())[:4])
+        lines.append(f"  budget split runs: {s}")
+    off = g.get("hash_off")
+    if off:
+        lines.append(
+            "  hash-aggregate blacklisted: " + ", ".join(sorted(off)[:4])
+        )
+    return lines

@@ -24,6 +24,14 @@ class Status:
         self._lock = threading.Lock()
         self._task_state: Dict[str, TaskState] = {}
         self._op_of: Dict[str, str] = {}
+        # Executor-provided resource telemetry (utils/resources.py):
+        # the session wires executor.resource_stats here so render()
+        # carries HBM / RSS / combiner gauges next to the task counts
+        # (exec/slicemachine.go:238-257 role).
+        self._resources_provider = None
+
+    def set_resources_provider(self, provider) -> None:
+        self._resources_provider = provider
 
     def __call__(self, task, state) -> None:
         with self._lock:
@@ -53,6 +61,14 @@ class Status:
             if err:
                 line += f", {err} failed/lost"
             lines.append(line)
+        provider = self._resources_provider
+        if provider is not None:
+            try:
+                from bigslice_tpu.utils import resources as res_mod
+
+                lines.extend(res_mod.render_stats(provider()))
+            except Exception:  # pragma: no cover - telemetry is
+                pass  # best-effort; never break the status line
         return "\n".join(lines)
 
 

@@ -29,6 +29,46 @@ def test_metrics_flow_task_to_result():
     assert counter.value(res.scope) == 4
 
 
+def test_metrics_exact_counts_device_columns(sess):
+    """A counter inside a Map over DEVICE columns must count rows
+    exactly on the local AND mesh executors (round-5 verdict #4): the
+    trace probe forces metric-touching fns onto the host tier, where
+    per-record increments are real — a traced incr would count
+    compiles, not rows."""
+    counter = metrics.new_counter("device_rows_seen")
+
+    def count_row(x):
+        counter.incr()
+        return (x, x * np.int32(2))
+
+    n = 1000
+    m = bs.Map(bs.Const(4, np.arange(n, dtype=np.int32)), count_row,
+               out=[np.int32, np.int32])
+    assert m.mode == "host"  # probe rejected the device tier
+    res = sess.run(m)
+    assert counter.value(res.scope) == n
+    # And the data itself is right.
+    total = sum(int(np.sum(np.asarray(f.to_host().cols[1])))
+                for f in res.frames())
+    assert total == 2 * sum(range(n))
+
+
+def test_metrics_explicit_jax_mode_rejected_loudly():
+    """mode='jax' + metrics is a contradiction: rejected with a message
+    naming the metrics problem, not a generic 'not traceable'."""
+    from bigslice_tpu.typecheck import TypecheckError
+
+    counter = metrics.new_counter("loud_reject")
+
+    def count_row(x):
+        counter.incr()
+        return x * 2
+
+    with pytest.raises(TypecheckError, match="metrics"):
+        bs.Map(bs.Const(2, np.arange(8, dtype=np.int32)), count_row,
+               mode="jax")
+
+
 def test_metrics_merge():
     c = metrics.new_counter("m")
     s1, s2 = metrics.Scope(), metrics.Scope()
@@ -157,6 +197,44 @@ def test_topn():
         t.add(score, item)
     assert [it for _, it in t.items()] == ["c", "d", "a"]
     assert topn.top_n([(1, "x"), (2, "y")], 1) == [(2, "y")]
+
+
+def test_resource_telemetry_in_status_and_debug():
+    """Round-5 verdict #6: per-device memory / RSS / combiner gauges
+    surface in the live status render and /debug/resources during a
+    mesh run. (The virtual CPU mesh reports no per-device allocator
+    stats — those lines appear on real TPU backends — but RSS, the
+    executor's resident-output accounting, and the gauges must be
+    live everywhere.)"""
+    import urllib.request
+
+    import jax
+    from jax.sharding import Mesh
+
+    from bigslice_tpu.exec.meshexec import MeshExecutor
+    from bigslice_tpu.exec.session import Session
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("shards",))
+    sess = Session(executor=MeshExecutor(mesh), debug_port=0)
+    keys = np.arange(4096, dtype=np.int32) % 97
+    res = sess.run(bs.Reduce(bs.Const(8, keys, np.ones(4096, np.int32)),
+                             lambda a, b: a + b))
+    stats = sess.executor.resource_stats()
+    assert stats["host_rss_bytes"] and stats["host_rss_bytes"] > 0
+    assert stats["resident_output_bytes"] > 0
+    assert stats["gauges"]["device_groups"] >= 1
+    assert "shuffle_slack" in stats["gauges"]
+    rendered = sess.status.render()
+    assert "host rss:" in rendered
+    assert "device-resident outputs:" in rendered
+    body = urllib.request.urlopen(
+        f"http://127.0.0.1:{sess.debug.port}/debug/resources",
+        timeout=5,
+    ).read()
+    parsed = json.loads(body)
+    assert parsed["host_rss_bytes"] > 0
+    assert "gauges" in parsed
+    res.discard()
 
 
 def test_debug_http_endpoints():

@@ -158,7 +158,7 @@ def test_strparse_pool_path_matches(monkeypatch):
     import bigslice_tpu.models.urls as urls_mod
 
     monkeypatch.setenv("BIGSLICE_PARSE_PROCS", "2")
-    strparse._POOL = None
+    strparse.shutdown_pool()
     lines = [f"http://S{i % 97}.example.com/p{i}" for i in range(4096)]
     lines[17] = "Ünïcode://CASÉ/p"  # non-ascii fixup inside a chunk
     vocab = dictenc.GlobalVocab()
@@ -166,7 +166,7 @@ def test_strparse_pool_path_matches(monkeypatch):
     assert list(vocab.decode(codes)) == [
         urls_mod._domain(u) for u in lines
     ]
-    strparse._POOL = None
+    strparse.shutdown_pool()
 
 
 def test_scanreader_sequence_source_matches_generator():
