@@ -89,7 +89,12 @@ class UngatheredOutputError(RuntimeError):
 # with restart-the-fleet advice.
 _DIST_ERR_MARKERS = (
     "gloo allgather failed", "gloo allreduce failed",
-    "gloo alltoall failed", "connection reset by peer",
+    "gloo alltoall failed",
+    # Hyphenated spellings (newer gloo builds; seen live from a peer
+    # SIGKILLed mid-collective in the --killrun chaos smoke).
+    "gloo all-reduce failed", "gloo all-gather failed",
+    "gloo all-to-all failed",
+    "connection reset by peer", "connection closed by peer",
     "coordination service", "stopped sending heartbeats",
     "worker was preempted",
     "distributed service detected fatal errors",

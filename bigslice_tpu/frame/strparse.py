@@ -36,6 +36,7 @@ GIL).
 from __future__ import annotations
 
 import os
+import threading
 from typing import Callable, Optional, Sequence
 
 import numpy as np
@@ -164,7 +165,8 @@ def domains_codes_single(lines: Sequence, vocab,
 
 _POOL = None
 _POOL_PROCS = 0
-_POOL_LOCK = None
+_POOL_LOCK = threading.Lock()  # created at import: the lazy-creation
+# alternative is itself a check-then-set race
 
 
 def parse_procs() -> int:
@@ -184,14 +186,10 @@ def _pool():
     deadlock. Workers only import numpy/pyarrow (~1s once per pool,
     amortized across the corpus). The pool is terminated at interpreter
     exit and whenever the proc count changes."""
-    global _POOL, _POOL_PROCS, _POOL_LOCK
+    global _POOL, _POOL_PROCS
     procs = parse_procs()
     if procs < 2:
         return None
-    if _POOL_LOCK is None:
-        import threading
-
-        _POOL_LOCK = threading.Lock()
     # Locked check-then-create: executor worker threads parse shards
     # concurrently, and a race here would leak a whole spawned pool.
     with _POOL_LOCK:
@@ -209,11 +207,6 @@ def _pool():
 
 def shutdown_pool() -> None:
     """Terminate the shared parse pool (idempotent)."""
-    global _POOL_LOCK
-    if _POOL_LOCK is None:
-        import threading
-
-        _POOL_LOCK = threading.Lock()
     with _POOL_LOCK:
         _shutdown_pool_locked()
 

@@ -114,11 +114,54 @@ def registry_digest() -> str:
     return h.hexdigest()
 
 
-def verify_registry_across_hosts() -> None:
-    """Raise if hosts disagree on the Func registry (multi-host only).
+def func_locations() -> list:
+    """Per-Func registration records "file:line: name" in registry
+    order — the reference's FuncLocations (func.go:260-274), the raw
+    material of the mismatch diff."""
+    out = []
+    for f in registered():
+        code = getattr(f.fn, "__code__", None)
+        loc = (f"{code.co_filename}:{code.co_firstlineno}"
+               if code is not None else "<builtin>")
+        out.append(f"{loc}: {f.name}")
+    return out
 
-    Uses the jax.distributed key-value store via a broadcast of the
-    digest from process 0.
+
+def registry_diff(mine: Sequence[str], other: Sequence[str],
+                  mine_label: str = "this process",
+                  other_label: str = "process 0") -> str:
+    """Aligned diff of two FuncLocations lists naming exactly which
+    registrations drifted — the func.go:276-343 diagnosis (its
+    Levenshtein alignment, via difflib's matching-block alignment).
+    Returns '' when identical."""
+    import difflib
+
+    if list(mine) == list(other):
+        return ""
+    lines = [f"func registrations differ ({other_label} vs "
+             f"{mine_label}):"]
+    sm = difflib.SequenceMatcher(a=list(other), b=list(mine),
+                                 autojunk=False)
+    for tag, a0, a1, b0, b1 in sm.get_opcodes():
+        if tag == "equal":
+            continue
+        for i in range(a0, a1):
+            lines.append(f"  - [{i}] {other[i]}  (only on {other_label})")
+        for j in range(b0, b1):
+            lines.append(f"  + [{j}] {mine[j]}  (only on {mine_label})")
+    return "\n".join(lines)
+
+
+def verify_registry_across_hosts() -> None:
+    """Raise if hosts disagree on the Func registry (multi-host only),
+    naming exactly which registration drifted.
+
+    The digest comparison is cheap and runs first; on mismatch every
+    process publishes its full FuncLocations through the coordination
+    KV and diffs itself against process 0 (func.go:276-343's aligned
+    diagnosis) — "digest mismatch" alone tells an operator nothing
+    about WHICH conditional registration or import-order divergence to
+    fix.
     """
     import jax
 
@@ -134,9 +177,32 @@ def verify_registry_across_hosts() -> None:
     # must see the mismatch, or the coordinator sails on and deadlocks
     # at its next collective while the drifted host raises.
     all_digests = np.asarray(multihost_utils.process_allgather(local))
-    if not (all_digests == local[None, :]).all():
-        raise RuntimeError(
-            "bigslice_tpu Func registry differs between hosts: "
-            "ensure every process registers the same @func definitions "
-            "in the same order (no conditional registration)"
+    if (all_digests == local[None, :]).all():
+        return
+    detail = ""
+    try:
+        from jax._src import distributed as jdist
+
+        client = jdist.global_state.client
+        mine = func_locations()
+        client.key_value_set(
+            f"bigslice/funcreg/{jax.process_index()}",
+            "\n".join(mine),
         )
+        # Blocking get: process 0 has either published already or is
+        # about to (every process reaches this branch — the allgather
+        # above is symmetric).
+        theirs = client.blocking_key_value_get(
+            "bigslice/funcreg/0", 30_000
+        )
+        if isinstance(theirs, bytes):
+            theirs = theirs.decode()
+        detail = registry_diff(mine, theirs.split("\n"))
+    except Exception:  # pragma: no cover - KV exchange is best-effort
+        pass
+    raise RuntimeError(
+        "bigslice_tpu Func registry differs between hosts: "
+        "ensure every process registers the same @func definitions "
+        "in the same order (no conditional registration)"
+        + (f"\n{detail}" if detail else "")
+    )

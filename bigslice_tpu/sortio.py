@@ -38,6 +38,12 @@ from bigslice_tpu.slicetype import Schema
 DEFAULT_RUN_ROWS = 1 << 18
 
 
+# Cumulative spilled-row count across all Spillers (observability:
+# the combiner-instrumentation role of exec/combiner.go:24-29; the
+# slicer oom scenario asserts the spill path actually engaged).
+SPILLED_ROWS = 0
+
+
 class Spiller:
     """Spill sorted frame runs to a temp directory; read them back as
     streams (mirrors sliceio.Spiller, sliceio/spiller.go:27-127)."""
@@ -55,6 +61,8 @@ class Spiller:
             for f in frames:
                 fp.write(codec.encode_frame(f))
                 rows += len(f)
+        global SPILLED_ROWS
+        SPILLED_ROWS += rows
         return rows
 
     def readers(self) -> List[sliceio.Reader]:

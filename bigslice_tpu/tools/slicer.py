@@ -122,8 +122,75 @@ def run_waves(sess, rows, shards):
     return total
 
 
+def run_oom(sess, rows, shards):
+    """Memory-pressure scenario (cmd/slicer/main.go:20-36's 'oom' mode
+    re-expressed for the TPU runtime): instead of inviting the OS OOM
+    killer, drive BOTH pressure-relief paths under a working set that
+    deliberately exceeds the budgets, and assert exact completion:
+
+    1. device tier — a per-device HBM budget far below the wave's
+       working set forces the budget splitter (exec/meshexec.py): the
+       group runs as K row-slices whose sub-outputs merge;
+    2. host tier — a combinerless shuffle through a streaming FileStore
+       overflows SHUFFLE_SPILL_ROWS and spills partition buffers to
+       disk (sortio.Spiller), streaming them back at store time.
+
+    Both engagements are asserted, not assumed."""
+    import tempfile
+
+    import jax
+    from jax.sharding import Mesh
+
+    import bigslice_tpu as bs
+    from bigslice_tpu import sortio
+    from bigslice_tpu.exec import store as store_mod
+    from bigslice_tpu.exec.local import LocalExecutor
+    from bigslice_tpu.exec.meshexec import MeshExecutor
+    from bigslice_tpu.exec.session import Session
+
+    keys, vals = _data(rows, max(1, rows // 100), seed=6)
+
+    # 1. HBM-budget splitting on the mesh.
+    mesh = Mesh(np.array(jax.devices()), ("shards",))
+    ex = MeshExecutor(mesh, device_budget_bytes=1 << 14)
+    msess = Session(executor=ex)
+    res = msess.run(bs.Reduce(bs.Const(shards, keys, vals), _add))
+    total = sum(v for _, v in res.rows())
+    assert total == int(vals.sum()), (total, int(vals.sum()))
+    assert ex.split_runs, "HBM-budget splitter never engaged"
+    K = max(ex.split_runs.values())
+
+    # 2. Host shuffle spill through a streaming store. The spill
+    # threshold scales DOWN to the scenario size (the reference's oom
+    # mode over-allocates up to the limit; we bring the limit to the
+    # workload) so the pressure path runs at any -rows.
+    from bigslice_tpu.exec import local as local_mod
+
+    saved = local_mod.SHUFFLE_SPILL_ROWS
+    # Per producer task each of `shards` partitions sees ~rows/shards²
+    # rows; halve that so the threshold trips inside every task.
+    local_mod.SHUFFLE_SPILL_ROWS = max(64, rows // (2 * shards * shards))
+    try:
+        with tempfile.TemporaryDirectory() as d:
+            hsess = Session(executor=LocalExecutor(
+                store=store_mod.FileStore(d)
+            ))
+            before = sortio.SPILLED_ROWS
+            res = hsess.run(bs.Reshuffle(bs.Const(shards, keys)))
+            n = sum(1 for _ in res.rows())
+            assert n == rows, (n, rows)
+            spilled = sortio.SPILLED_ROWS - before
+            assert spilled > 0, "host shuffle spill never engaged"
+    finally:
+        local_mod.SHUFFLE_SPILL_ROWS = saved
+    msess.shutdown()
+    hsess.shutdown()
+    return f"split K={K}, spilled {spilled} rows"
+
+
 MODES = {
     "reduce": run_reduce,
+    "oom": run_oom,
     "cogroup": run_cogroup,
     "memiter": run_memiter,
     "shuffle": run_shuffle,

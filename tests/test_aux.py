@@ -278,6 +278,39 @@ def test_slicetypecheck_tool():
     assert "x.py:5" in problems[0] and "x.py:8" in problems[1]
 
 
+def test_slicetypecheck_type_aware():
+    """Round-5 verdict #7: wrong-dtype args against @func annotations
+    and statically non-serializable args are rejected; dynamic or
+    unannotated args never false-positive."""
+    from bigslice_tpu.tools import slicetypecheck as stc
+
+    src = (
+        "import bigslice_tpu as bs\n"
+        "@bs.func\n"
+        "def pipe(n: int, name: str, rate: np.float32, free):\n"
+        "    return None\n"
+        "x = 'hello'\n"
+        "sess.run(pipe, 4, 'corpus', 0.5, object())\n"   # ok
+        "sess.run(pipe, 'four', 'corpus', 0.5, 1)\n"     # n: str
+        "sess.run(pipe, 4, 7, 0.5, 1)\n"                 # name: int
+        "sess.run(pipe, 4, x, 2, 1)\n"                   # ok (int->f32)\n"
+        "sess.run(pipe, 4, [1], 0.5, 1)\n"               # name: list
+        "sess.run(pipe, dynamic_thing, 'c', 0.5, 1)\n"   # ok (dynamic)
+        "sess.run(pipe, 4, 'c', 0.5, lambda: 1)\n"       # lambda
+        "sess.run(pipe, 4, 'c', 0.5, open('f'))\n"       # file handle
+        "sess.run(pipe, 4, 'c', 0.5, (i for i in x))\n"  # generator
+    )
+    problems = stc.check_source(src, "t.py")
+    lines = sorted(int(p.split(":")[1]) for p in problems)
+    assert lines == [7, 8, 10, 12, 13, 14], problems
+    joined = "\n".join(problems)
+    assert "declares int" in joined
+    assert "declares str" in joined
+    assert "lambda" in joined
+    assert "file handle" in joined
+    assert "generator" in joined
+
+
 def test_slicer_tool(tmp_path, monkeypatch, capsys):
     from bigslice_tpu import sliceconfig
     from bigslice_tpu.tools import slicer
@@ -300,6 +333,46 @@ def test_registry_digest_stable():
         return bs.Const(1, [1])
 
     assert func_mod.registry_digest() != d1
+
+
+def test_registry_mismatch_diff_names_drifted_func():
+    """Round-5 verdict #10: a registry mismatch must NAME the drifted
+    registration (func.go:276-343's aligned FuncLocations diff), not
+    just report a digest difference."""
+    from bigslice_tpu.ops import func as func_mod
+
+    base = [
+        "pipe.py:10: ingest",
+        "pipe.py:20: transform",
+        "pipe.py:30: publish",
+    ]
+    # One host conditionally registered an extra Func in the middle.
+    drifted = base[:2] + ["debug.py:7: debug_dump"] + base[2:]
+    diff = func_mod.registry_diff(drifted, base,
+                                  mine_label="host 3")
+    assert "debug_dump" in diff
+    assert "debug.py:7" in diff
+    assert "only on host 3" in diff
+    # Aligned: the shared registrations do NOT appear as drift.
+    assert "ingest" not in diff and "publish" not in diff
+    # Replacement drift names both sides.
+    swapped = base[:1] + ["pipe.py:21: transform_v2"] + base[2:]
+    diff2 = func_mod.registry_diff(swapped, base)
+    assert "transform_v2" in diff2 and "transform" in diff2
+    # Identical registries: no diff.
+    assert func_mod.registry_diff(base, list(base)) == ""
+
+
+def test_func_locations_records_definitions():
+    from bigslice_tpu.ops import func as func_mod
+
+    @bs.func
+    def _located():
+        return bs.Const(1, [1])
+
+    locs = func_mod.func_locations()
+    assert any("_located" in entry and "test_aux.py" in entry
+               for entry in locs)
 
 
 def test_microbench_tool(capsys):

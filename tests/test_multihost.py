@@ -64,3 +64,22 @@ def test_host_loss_surfaces_fast():
     )
     assert out.returncode == 0, (out.stdout[-1500:], out.stderr[-1500:])
     assert "CHAOS_OK" in out.stdout
+
+
+def test_mid_collective_kill_classified_fast():
+    """Round-5 verdict #8: a peer SIGKILLed while an SPMD collective is
+    EXECUTING (not between runs, not before launch) must surface on the
+    survivor as a classified HostLostError fast — the in-flight
+    collective errors instead of hanging. Also pins the hyphenated
+    Gloo error spellings in the host-loss classifier, which this smoke
+    discovered live."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, "-m", "bigslice_tpu.tools.multihost_smoke",
+         "--killrun"],
+        capture_output=True, text=True, timeout=400, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert out.returncode == 0, (out.stdout[-1500:], out.stderr[-1500:])
+    assert "KILLRUN_OK" in out.stdout
