@@ -68,8 +68,12 @@ CHUNK_BYTES = 1 << 20
 # How long a non-owner waits for the owner's epoch pointer when the
 # owner has no liveness signal (keepalive inactive or never-observed
 # beat). A beating owner is trusted indefinitely — the keepalive, not
-# the clock, is the loss detector.
-STATE_TIMEOUT_SECS = 600.0
+# the clock, is the loss detector. Config-surfaced (round-5 verdict
+# weak #8): keepalive-less deployments with long host stages need a
+# bigger allowance than the default.
+STATE_TIMEOUT_SECS = float(
+    __import__("os").environ.get("BIGSLICE_STATE_TIMEOUT_SECS", 600.0)
+)
 
 # Poll cadence for the state resolver thread.
 POLL_SECS = 0.1
