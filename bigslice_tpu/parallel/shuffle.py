@@ -124,12 +124,32 @@ def bucket_exchange(axis: str, nshards: int, send_cap: int, dest_row,
     return valid_mask, out_cols
 
 
+def sortless_routing_default() -> bool:
+    """Whether combinerless shuffles use one-hot-cumsum routing instead
+    of the routing sort. Default: on everywhere except real TPU
+    hardware — same rationale and knob convention as the hash-aggregate
+    lowering (exec/meshexec.py BIGSLICE_HASH_AGGREGATE): the ~40x
+    sort-vs-linear-pass gap is a CPU-mesh measurement (BASELINE.md
+    round 5), while on TPU the [size, ndest] one-hot cumsum multiplies
+    HBM traffic and the bitonic sort is the measured-safe default.
+    Override with BIGSLICE_SORTLESS_SHUFFLE=1/0."""
+    import os
+
+    import jax
+
+    env = os.environ.get("BIGSLICE_SORTLESS_SHUFFLE")
+    if env:
+        return env not in ("0", "false", "off")
+    return jax.default_backend() != "tpu"
+
+
 def make_shuffle_fn(nshards: int, nkeys: int, capacity: int,
                     axis: str = "shards", seed: int = 0,
                     partition_fn: Optional[Callable] = None,
                     slack: float = 2.0,
                     use_pallas: Optional[bool] = None,
-                    nparts: Optional[int] = None):
+                    nparts: Optional[int] = None,
+                    sortless: Optional[bool] = None):
     """Build the per-device shuffle body (to be wrapped in shard_map).
 
     Operates on ``cols`` (each shape [capacity]) plus a valid-row count
@@ -169,6 +189,20 @@ def make_shuffle_fn(nshards: int, nkeys: int, capacity: int,
         capacity, nshards if waved else nparts, slack
     )
 
+    # Above this lane count the [size, ndest] one-hot rank cumsum's
+    # O(n·ndest) work overtakes the O(n log n) routing sort it
+    # replaces; combinerless shuffles on meshes that big keep the sort.
+    SORTLESS_MAX_LANES = 32
+    # Destination lane count is static: device lanes when waved,
+    # partition lanes otherwise (nparts <= nshards in that case).
+    ndest_static = nshards if waved else nparts
+    if sortless is None:
+        # The lane cap bounds only the *default* resolution; an
+        # explicit request (tests, aotcheck's lowering proofs) always
+        # gets the routing it named.
+        sortless = (sortless_routing_default()
+                    and ndest_static <= SORTLESS_MAX_LANES)
+
     def body_masked(valid, *cols):
         """Mask-based core: rows where ``valid`` route; returns
         (recv_valid_mask, overflow, out_cols) with received rows left in
@@ -181,9 +215,14 @@ def make_shuffle_fn(nshards: int, nkeys: int, capacity: int,
         # that sorts last. The fused Pallas kernel (when engaged) also
         # returns the destination histogram, replacing the
         # scatter-lowered bincount below.
+        # The sortless path derives counts from its own cumsum and the
+        # waved sort path re-derives per-DEVICE counts from the sorted
+        # lanes, so the fused kernel's histogram is only requested when
+        # the non-waved sort path will actually consume it.
         part, bad, kernel_counts = partition_ids(
             keys, nparts, seed, valid=valid, partition_fn=partition_fn,
-            use_pallas=use_pallas, with_counts=True,
+            use_pallas=use_pallas,
+            with_counts=not sortless and not waved,
         )
         n_bad = (
             jnp.int32(0) if bad is None
@@ -203,27 +242,45 @@ def make_shuffle_fn(nshards: int, nkeys: int, capacity: int,
         else:
             ndest = nparts
 
-        # Sort rows by destination; payload rides along (vector columns
-        # follow a carried permutation — segment.sort_with_payload).
-        from bigslice_tpu.parallel.segment import sort_with_payload
+        if sortless:
+            # SORTLESS routing: a row's bucket slot is its running
+            # count among same-destination rows — one [size, ndest]
+            # one-hot cumsum (order-preserving, so within-bucket row
+            # order stays the arrival order), no sort at all. On the
+            # sort-dominated CPU-mesh roofline (BASELINE.md round 5: a
+            # 3-operand sort costs ~40x a linear pass at these sizes)
+            # this removes the combinerless shuffle's only sort; see
+            # sortless_routing_default for the TPU gate.
+            onehot = (part[:, None] == jnp.arange(ndest,
+                                                  dtype=np.int32)[None])
+            csum = jnp.cumsum(onehot.astype(np.int32), axis=0)
+            counts = csum[-1]
+            offset = (
+                jnp.take_along_axis(
+                    csum,
+                    jnp.minimum(part, np.int32(ndest - 1))[:, None],
+                    axis=1,
+                )[:, 0] - 1
+            )
+            s_part, s_cols = part, cols
+        else:
+            # Sort rows by destination; payload rides along (vector
+            # columns follow a carried permutation).
+            from bigslice_tpu.parallel.segment import sort_with_payload
 
-        (s_part,), s_cols = sort_with_payload((part,), 1, cols)
-
-        # Row counts per destination and bucket-local offsets (the
-        # fused kernel already produced them on the pallas path; waved
-        # routing re-derives per-DEVICE counts from the sorted lanes).
-        counts = (
-            kernel_counts
-            if kernel_counts is not None and not waved
-            else jnp.bincount(s_part, length=ndest + 1)[:ndest]
-        )
-        starts = jnp.concatenate(
-            [jnp.zeros(1, np.int32),
-             jnp.cumsum(counts).astype(np.int32)[:-1]]
-        )
-        offset = jnp.arange(size, dtype=np.int32) - jnp.take(
-            starts, jnp.minimum(s_part, ndest - 1)
-        )
+            (s_part,), s_cols = sort_with_payload((part,), 1, cols)
+            counts = (
+                kernel_counts
+                if kernel_counts is not None and not waved
+                else jnp.bincount(s_part, length=ndest + 1)[:ndest]
+            )
+            starts = jnp.concatenate(
+                [jnp.zeros(1, np.int32),
+                 jnp.cumsum(counts).astype(np.int32)[:-1]]
+            )
+            offset = jnp.arange(size, dtype=np.int32) - jnp.take(
+                starts, jnp.minimum(s_part, ndest - 1)
+            )
 
         # Scatter into (nshards, send_cap) send buckets; rows beyond
         # capacity (or invalid) drop — reported via `overflow`.

@@ -70,18 +70,26 @@ def _programs(mesh, axis: str):
             check_rep=False,
         ))
 
-    # 1. Routing shuffle (sort lowering).
-    sort_body = shuffle_mod.make_shuffle_fn(nmesh, 1, SIZE, axis)
+    # 1. Routing shuffle — BOTH lowerings, pinned explicitly (the
+    # build-time backend default would read this CPU process, not the
+    # TPU target): the sort path is what runs on TPU by default, the
+    # sortless one-hot path must also prove it compiles for the day
+    # BIGSLICE_SORTLESS_SHUFFLE=1 flips it on.
+    for name, sortless in (("shuffle_sort", False),
+                           ("shuffle_sortless", True)):
+        body = shuffle_mod.make_shuffle_fn(
+            nmesh, 1, SIZE, axis, sortless=sortless
+        )
 
-    def shuffle_sort(counts, k, v):
-        n, ov, cols = sort_body(counts[0], k, v)
-        return (n.reshape(1), cols[0], cols[1], ov)
+        def shuffle_route(counts, k, v, body=body):
+            n, ov, cols = body(counts[0], k, v)
+            return (n.reshape(1), cols[0], cols[1], ov)
 
-    progs["shuffle_sort"] = (
-        smap(shuffle_sort, 3, 3, scalar_out=1),
-        [S((nmesh,), i32), S((nmesh * SIZE,), i32),
-         S((nmesh * SIZE,), i32)],
-    )
+        progs[name] = (
+            smap(shuffle_route, 3, 3, scalar_out=1),
+            [S((nmesh,), i32), S((nmesh * SIZE,), i32),
+             S((nmesh * SIZE,), i32)],
+        )
 
     # 2. Fused combine+shuffle + reduce-side combine (sort pipeline).
     cfn = segment.canonical_combine(lambda a, b: a + b, 1)

@@ -205,8 +205,10 @@ def test_mesh_shuffle_pallas_hash_path(mesh):
 
     outs = {}
     for use_pallas in (False, True):
+        # sortless=False: keep the kernel_counts-consuming sort branch
+        # (the TPU-default routing) under test on the CPU mesh.
         body = shuffle_mod.make_shuffle_fn(
-            n, 1, cap, "shards", use_pallas=use_pallas
+            n, 1, cap, "shards", use_pallas=use_pallas, sortless=False
         )
 
         def stepped(cnt, k, v):
@@ -225,3 +227,49 @@ def test_mesh_shuffle_pallas_hash_path(mesh):
     np.testing.assert_array_equal(outs[False][0], outs[True][0])
     np.testing.assert_array_equal(outs[False][1], outs[True][1])
     np.testing.assert_array_equal(outs[False][2], outs[True][2])
+
+
+@pytest.mark.parametrize("nparts_mult", [1, 3])
+def test_mesh_shuffle_sortless_parity(mesh, nparts_mult):
+    """One-hot-cumsum routing and the routing sort produce bit-identical
+    shuffles (both preserve within-bucket arrival order), flat and waved
+    — this is also the sort branch's only coverage on meshes small
+    enough that the lane-count bound would always pick sortless."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from bigslice_tpu.parallel.meshutil import get_shard_map
+
+    rng = np.random.RandomState(4)
+    n = mesh.devices.size
+    cap = 256
+    per = 96
+    nparts = n * nparts_mult
+    kc = [rng.randint(0, 500, per).astype(np.int32) for _ in range(n)]
+    vc = [rng.randint(0, 100, per).astype(np.int32) for _ in range(n)]
+    cols, counts = shuffle_mod.shard_columns(mesh, [kc, vc], [per] * n, cap)
+
+    outs = {}
+    for sortless in (False, True):
+        body = shuffle_mod.make_shuffle_fn(
+            n, 1, cap, "shards", nparts=nparts, sortless=sortless
+        )
+
+        def stepped(cnt, k, v):
+            c, ov, out = body(cnt[0], k, v)
+            return c.reshape(1), ov, tuple(out)
+
+        f = jax.jit(get_shard_map()(
+            stepped, mesh=mesh,
+            in_specs=(P("shards"), P("shards"), P("shards")),
+            out_specs=(P("shards"), P(),
+                       tuple(P("shards") for _ in range(2 + (nparts > n)))),
+            check_rep=False,
+        ))
+        oc, ov, out = f(counts, cols[0], cols[1])
+        outs[sortless] = (np.asarray(oc), int(ov),
+                          [np.asarray(c) for c in out])
+    np.testing.assert_array_equal(outs[False][0], outs[True][0])
+    assert outs[False][1] == outs[True][1] == 0
+    for a, b in zip(outs[False][2], outs[True][2]):
+        np.testing.assert_array_equal(a, b)
