@@ -88,12 +88,22 @@ class GlobalVocab:
     def encode_extending(self, col: Sequence) -> np.ndarray:
         """Encode a column, assigning fresh codes to unseen values —
         vocabulary build and encode fused into one locked pass (the
-        wordcount hot path: one hash probe per row instead of two)."""
+        wordcount hot path). The lookup sweep stays in C
+        (map(dict.get)); only genuinely new values touch the Python
+        insert loop, so the steady state (vocab already built) costs
+        one C-dispatched probe per row."""
+        import itertools
+
         with self._lock:
             idx = self._index
             vals = self._values
-            out = np.empty(len(col), dtype=np.int32)
-            for i, v in enumerate(col):
+            out = np.fromiter(
+                map(idx.get, col, itertools.repeat(-1)),
+                np.int32, len(col),
+            )
+            miss = np.flatnonzero(out < 0)
+            for i in miss.tolist():
+                v = col[i]
                 c = idx.get(v)
                 if c is None:
                     c = len(vals)

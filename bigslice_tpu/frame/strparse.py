@@ -148,17 +148,78 @@ def domains_codes_single(lines: Sequence, vocab,
         out[:] = [fallback_fn(u) for u in lines]
         return vocab.encode_extending(out)
 
+    # Native tier first: one fused C pass (framing, span extraction,
+    # lower, dict-encode — bigslice_tpu/native/strscan.c) vs the
+    # five-pass numpy+Arrow chain below. Same fallback ladder: framing
+    # ambiguity → None → Arrow → slow_path.
+    native = _native_codes(lines, n, vocab, fallback_fn)
+    if native is not None:
+        return native
+
     try:
         import pyarrow  # noqa: F401
     except ImportError:  # pragma: no cover - pyarrow is baked in
         return slow_path()
-    joined = "\n/".join(lines).encode("utf-8") + b"\n/"
+    try:
+        joined = "\n/".join(lines).encode("utf-8") + b"\n/"
+    except TypeError:  # non-str rows: the slow path's problem
+        return slow_path()
     enc = _domains_encoded(joined.translate(_LOWER), n)
     if enc is None:
         return slow_path()
     codes = _merge_codes(enc, vocab)
     _fix_nonascii(joined, lines, codes, vocab, fallback_fn)
     return codes
+
+
+def _native_codes(lines, n: int, vocab, fallback_fn):
+    """Parse+encode through the native kernel; None when unavailable
+    or the buffer framing is ambiguous. Uniques come back already
+    lowered and ASCII-pure (non-ASCII domain spans arrive as -1 codes
+    and re-parse through the exact Python path), so no quarantine pass
+    is needed — the quarantine lives inside the kernel."""
+    from bigslice_tpu import native
+
+    if not native.enabled():
+        return None
+    # Preferred: the CPython-extension kernel parses the list in place
+    # (no joined-buffer copy, embedded newlines handled exactly); the
+    # ctypes joined-buffer kernel is the toolchain-minimal rung below.
+    if not isinstance(lines, list):
+        lines = (lines.tolist() if isinstance(lines, np.ndarray)
+                 else list(lines))
+    res = native.domains_encode_list(lines)
+    if res is None:
+        try:
+            joined = "\n".join(lines).encode("utf-8") + b"\n"
+        except TypeError:  # non-str rows: the slow path's problem
+            return None
+        res = native.domains_encode(joined, n)
+    if res is None:
+        return None
+    return _merge_native(res[0], res[1], lines, vocab, fallback_fn)
+
+
+def _merge_native(local_codes, uniques, lines, vocab,
+                  fallback_fn) -> np.ndarray:
+    """Batch-local native codes → global-vocab codes. Uniques arrive
+    lowered and ASCII-pure (the kernel quarantines non-ASCII domain
+    spans as -1), so no quarantine pass is needed; -1 rows re-parse
+    through the exact Python path."""
+    n = len(local_codes)
+    out = np.empty(n, np.int32)
+    if uniques:
+        keep = np.empty(len(uniques), dtype=object)
+        keep[:] = uniques
+        remap = np.asarray(vocab.encode_extending(keep), np.int32)
+        ok = local_codes >= 0
+        out[ok] = remap[local_codes[ok]]
+    bad = np.flatnonzero(local_codes < 0)
+    if len(bad):
+        fixed = np.empty(len(bad), dtype=object)
+        fixed[:] = [fallback_fn(lines[i]) for i in bad]
+        out[bad] = vocab.encode_extending(fixed)
+    return out
 
 
 # ---------------------------------------------------------------- pool
@@ -221,11 +282,22 @@ def _shutdown_pool_locked() -> None:
 
 
 def _worker_parse(args):
+    """Pool worker: parse one "\\n/"-joined chunk. Native kernel first
+    (rows cannot contain '\\n' under this framing, so every "\\n/" is a
+    separator and the plain-framing rewrite below is exact — ambiguity
+    makes BOTH tiers bail to None and the parent slow-paths the
+    chunk); the Arrow chain is the rung below. Returns a tagged tuple
+    so the parent runs the matching merge."""
+    from bigslice_tpu import native
+
     joined, n = args
+    res = native.domains_encode(joined.replace(b"\n/", b"\n"), n)
+    if res is not None:
+        return ("native", res[0], res[1])
     enc = _domains_encoded(joined.translate(_LOWER), n)
     if enc is None:
         return None
-    return (enc.indices.to_numpy().astype(np.int32),
+    return ("arrow", enc.indices.to_numpy().astype(np.int32),
             enc.dictionary.to_pylist())
 
 
@@ -257,8 +329,12 @@ def domains_codes(lines: Sequence, vocab,
             out[pos : pos + len(ch)] = domains_codes_single(
                 ch, vocab, fallback_fn
             )
+        elif res[0] == "native":
+            out[pos : pos + len(ch)] = _merge_native(
+                res[1], res[2], ch, vocab, fallback_fn
+            )
         else:
-            indices, batch_vocab = res
+            _tag, indices, batch_vocab = res
             codes = _merge_codes_raw(indices, batch_vocab, vocab)
             _fix_nonascii(joined, ch, codes, vocab, fallback_fn)
             out[pos : pos + len(ch)] = codes

@@ -1,0 +1,195 @@
+"""Native host-tier kernels (C, loaded via ctypes).
+
+The compute path of this framework is JAX/XLA/Pallas; the runtime
+around it follows the reference in using native code where Python
+costs per-row time. This package holds those kernels: C sources
+compiled on first use into a cached shared object next to the source
+(no pip, no pybind11 — plain cc -O3 -shared + ctypes, per the
+environment contract).
+
+Every kernel has a pure-Python/Arrow fallback at its call site, so a
+missing compiler degrades throughput, never correctness. Set
+BIGSLICE_NATIVE=0 to force the fallbacks (the A/B knob the benches
+and tests use).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import shutil
+import subprocess
+import threading
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "strscan.c")
+_SO = os.path.join(_DIR, "_strscan.so")
+_LIST_SRC = os.path.join(_DIR, "strlist.c")
+_LIST_SO = os.path.join(_DIR, "_strlist.so")
+
+_LOCK = threading.Lock()
+_LIB = None
+_LOAD_FAILED = False
+_LIST_MOD = None
+_LIST_FAILED = False
+
+
+def enabled() -> bool:
+    return os.environ.get("BIGSLICE_NATIVE", "1") not in (
+        "0", "false", "off"
+    )
+
+
+def _build_locked(src: str, so: str,
+                  extra: tuple = ()) -> Optional[str]:
+    """Compile ``src`` → ``so`` when stale or absent. Returns the .so
+    path, or None when no compiler is available / the build fails."""
+    if (os.path.exists(so)
+            and os.path.getmtime(so) >= os.path.getmtime(src)):
+        return so
+    cc = shutil.which("cc") or shutil.which("gcc") or shutil.which("g++")
+    if cc is None:
+        return None
+    tmp = so + f".tmp{os.getpid()}"
+    try:
+        subprocess.run(
+            [cc, "-O3", "-shared", "-fPIC", *extra, "-o", tmp, src],
+            check=True, capture_output=True, timeout=120,
+        )
+        os.replace(tmp, so)  # atomic: concurrent processes race safely
+    except (subprocess.SubprocessError, OSError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return None
+    return so
+
+
+def _load():
+    """The loaded library, building it on first use; None when native
+    is disabled or the toolchain is unavailable (fallbacks engage)."""
+    global _LIB, _LOAD_FAILED
+    if not enabled():
+        return None
+    if _LIB is not None or _LOAD_FAILED:
+        return _LIB
+    with _LOCK:
+        if _LIB is not None or _LOAD_FAILED:
+            return _LIB
+        so = _build_locked(_SRC, _SO)
+        if so is None:
+            _LOAD_FAILED = True
+            return None
+        try:
+            lib = ctypes.CDLL(so)
+        except OSError:
+            _LOAD_FAILED = True
+            return None
+        lib.bs_domains_encode.restype = ctypes.c_int64
+        lib.bs_domains_encode.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_int64,
+        ]
+        _LIB = lib
+        return _LIB
+
+
+def _load_list():
+    """The _strlist CPython-extension module, building it on first
+    use; None when native is disabled or the build/import fails."""
+    global _LIST_MOD, _LIST_FAILED
+    if not enabled():
+        return None
+    if _LIST_MOD is not None or _LIST_FAILED:
+        return _LIST_MOD
+    with _LOCK:
+        if _LIST_MOD is not None or _LIST_FAILED:
+            return _LIST_MOD
+        import sysconfig
+
+        inc = sysconfig.get_paths().get("include")
+        if not inc or not os.path.exists(os.path.join(inc, "Python.h")):
+            _LIST_FAILED = True
+            return None
+        so = _build_locked(_LIST_SRC, _LIST_SO, extra=("-I" + inc,))
+        if so is None:
+            _LIST_FAILED = True
+            return None
+        try:
+            import importlib.machinery
+            import importlib.util
+
+            # Loader name must match PyInit__strlist; the module is
+            # held privately (never placed in sys.modules).
+            loader = importlib.machinery.ExtensionFileLoader(
+                "_strlist", so
+            )
+            spec = importlib.util.spec_from_file_location(
+                "_strlist", so, loader=loader
+            )
+            mod = importlib.util.module_from_spec(spec)
+            loader.exec_module(mod)
+        except ImportError:
+            _LIST_FAILED = True
+            return None
+        _LIST_MOD = mod
+        return _LIST_MOD
+
+
+def domains_encode_list(
+        lines) -> Optional[Tuple[np.ndarray, List[str]]]:
+    """Dictionary-encode per-row domains straight off a list of str —
+    the preferred native path (no joined-buffer copy, no framing
+    restriction; see strlist.c). Same return contract as
+    ``domains_encode``; None when the extension is unavailable or any
+    row is not str."""
+    mod = _load_list()
+    if mod is None:
+        return None
+    if not isinstance(lines, list):
+        lines = list(lines)
+    res = mod.domains_encode(lines)
+    if res is None:
+        return None
+    codes_b, uniques = res
+    return np.frombuffer(codes_b, np.int32), uniques
+
+
+def domains_encode(joined: bytes,
+                   n: int) -> Optional[Tuple[np.ndarray, List[str]]]:
+    """Dictionary-encode per-row domains over a "\\n"-joined (NOT
+    lowered) buffer of ``n`` rows, each terminated by ``\\n``.
+
+    Returns ``(codes, uniques)``: int32 codes per row indexing the
+    lowered unique-domain list, with ``-1`` marking rows whose domain
+    span is non-ASCII (caller re-parses those through the exact Python
+    path). Returns None when the native kernel is unavailable or the
+    buffer framing is ambiguous (embedded newlines) — callers fall
+    back, same contract as the Arrow path.
+    """
+    lib = _load()
+    if lib is None or n == 0:
+        return None
+    buf = np.frombuffer(joined, np.uint8)
+    codes = np.empty(n, np.int32)
+    # Worst case every row's domain is unique and spans its whole row.
+    uniq_buf = np.empty(max(1, len(joined)), np.uint8)
+    uniq_off = np.empty(n + 1, np.int64)
+    rc = lib.bs_domains_encode(
+        buf.ctypes.data, len(joined), n,
+        codes.ctypes.data, uniq_buf.ctypes.data, len(uniq_buf),
+        uniq_off.ctypes.data, n,
+    )
+    if rc < 0:
+        return None
+    uniq_bytes = uniq_buf.tobytes()
+    uniques = [
+        uniq_bytes[uniq_off[i]:uniq_off[i + 1]].decode("ascii")
+        for i in range(rc)
+    ]
+    return codes, uniques

@@ -1,0 +1,138 @@
+"""Native ingestion kernel (bigslice_tpu/native/strscan.c): exact
+equivalence with the Python oracle `_domain`, including the quarantine
+and fallback ladders. The kernel is host-only C — no jax involved —
+but correctness here gates the wordcount/urls pipeline's parse stage.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from bigslice_tpu import native
+from bigslice_tpu.frame import strparse
+from bigslice_tpu.frame.dictenc import GlobalVocab
+from bigslice_tpu.models.urls import _domain
+
+
+def _codes_to_domains(codes, vocab):
+    return vocab.decode(codes).tolist()
+
+
+CORPORA = {
+    "plain": [
+        "http://Example.COM/path/x",
+        "https://site.org",
+        "ftp://A.B.C/",
+        "no-scheme/just/path",
+        "bare-token",
+        "",
+        "//leading.double/slash",
+        "http://dup.com/1",
+        "HTTP://DUP.COM/2",
+        "a//b//c/d",
+    ],
+    "unicode": [
+        "http://Ünïcode.example/x",      # non-ASCII domain → fallback
+        "http://ascii.domain/päth",      # non-ASCII path, ASCII domain
+        "präfix http://mixed.com/x",     # non-ASCII before the //
+        "http://plain.com/x",
+    ],
+    "hostile": [
+        "/",
+        "//",
+        "///",
+        "http:///empty-domain",
+        "x" * 300,
+        "http://" + "y" * 200 + "/tail",
+        "slash-at-end/",
+        "double-slash-at-end//",
+    ],
+}
+
+
+@pytest.mark.parametrize("name", sorted(CORPORA))
+def test_native_matches_oracle(name):
+    lines = CORPORA[name]
+    res = native.domains_encode(
+        "\n".join(lines).encode("utf-8") + b"\n", len(lines)
+    )
+    if res is None:
+        pytest.skip("native kernel unavailable")
+    codes, uniques = res
+    for i, line in enumerate(lines):
+        want = _domain(line)
+        if codes[i] < 0:
+            # Quarantined rows must be exactly the non-ASCII-domain ones.
+            assert not want.isascii(), (line, want)
+        else:
+            assert uniques[codes[i]] == want, (line, want)
+
+
+def test_native_dedups_codes():
+    lines = ["http://a.com/%d" % (i % 7) for i in range(500)]
+    res = native.domains_encode(
+        "\n".join(lines).encode("utf-8") + b"\n", len(lines)
+    )
+    if res is None:
+        pytest.skip("native kernel unavailable")
+    codes, uniques = res
+    assert uniques == ["a.com"]
+    np.testing.assert_array_equal(codes, np.zeros(500, np.int32))
+
+
+def test_native_rejects_embedded_newline():
+    assert native.domains_encode(b"a\nb\n\n", 2) is None  # 3 rows framed
+
+
+def test_domains_codes_native_vs_disabled(monkeypatch):
+    """The full strparse entry point is bit-identical with the native
+    tier on and off (the off path is the Arrow/numpy chain)."""
+    rng = np.random.RandomState(5)
+    lines = []
+    for i in range(4000):
+        d = rng.randint(0, 97)
+        lines.append(f"http://Site{d}.Example.com/p/{i}")
+    lines[17] = "http://ünï.code/x"
+    lines[801] = "plain token"
+    lines[802] = ""
+
+    v1 = GlobalVocab()
+    c1 = strparse.domains_codes(lines, v1)
+    monkeypatch.setenv("BIGSLICE_NATIVE", "0")
+    v2 = GlobalVocab()
+    c2 = strparse.domains_codes(lines, v2)
+    assert _codes_to_domains(c1, v1) == _codes_to_domains(c2, v2)
+    assert _codes_to_domains(c1, v1) == [_domain(u) for u in lines]
+
+
+def test_pool_path_native_workers(monkeypatch):
+    """The process-pool parse path (multi-core hosts) rides the native
+    kernel inside each worker and stays oracle-exact, unicode rows
+    included."""
+    monkeypatch.setenv("BIGSLICE_PARSE_PROCS", "2")
+    strparse.shutdown_pool()
+    try:
+        lines = [f"http://Pool{i % 13}.org/x/{i}" for i in range(1024)]
+        lines[100] = "http://ünï.code/x"
+        lines[500] = "bare token"
+        v = GlobalVocab()
+        codes = strparse.domains_codes(lines, v, _domain,
+                                       chunk_rows=256)
+        assert _codes_to_domains(codes, v) == [_domain(u) for u in lines]
+    finally:
+        strparse.shutdown_pool()
+
+
+def test_fuzz_native_oracle():
+    rng = np.random.RandomState(9)
+    alphabet = list("abXY9./:éß ")
+    for trial in range(30):
+        lines = [
+            "".join(rng.choice(alphabet,
+                               rng.randint(0, 25)).tolist())
+            for _ in range(rng.randint(1, 40))
+        ]
+        v = GlobalVocab()
+        codes = strparse.domains_codes_single(lines, v, _domain)
+        assert _codes_to_domains(codes, v) == [_domain(u) for u in lines]
