@@ -64,11 +64,30 @@ def cached_run_sort(nkeys: int, ncols: int, dtypes: tuple) -> DeviceRunSort:
 DEVICE_SORT_MIN_ROWS = 4096
 
 
+def device_sort_default() -> bool:
+    """Whether device-schema frames sort with the jitted ``lax.sort``.
+    On real TPU that keeps rows on-chip and rides the fast XLA sort;
+    on CPU backends the XLA sort is the measured ~40×-slow primitive
+    (BASELINE.md round 5), so frames route to the host lexsort
+    instead — same per-backend knob convention as the hash-aggregate
+    and sortless-shuffle lowerings. Override with
+    BIGSLICE_DEVICE_SORT=1/0."""
+    import os
+
+    env = os.environ.get("BIGSLICE_DEVICE_SORT")
+    if env:
+        return env not in ("0", "false", "off")
+    import jax
+
+    return jax.default_backend() == "tpu"
+
+
 def device_sortable(frame) -> bool:
     return (
         frame.prefix >= 1
         and len(frame) >= DEVICE_SORT_MIN_ROWS
         and all(ct.is_device and ct.shape == () for ct in frame.schema)
+        and device_sort_default()
     )
 
 

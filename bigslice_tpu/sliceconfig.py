@@ -78,7 +78,11 @@ def make_session(cfg: dict):
             from bigslice_tpu.exec.meshexec import MeshExecutor
 
             mesh = Mesh(np.array(devs), ("shards",))
-            executor = MeshExecutor(mesh)
+            # Multi-process jobs need the SPMD dispatch contract
+            # (ordered launches, eager gathers — exec/spmd.py).
+            executor = MeshExecutor(
+                mesh, spmd=jax.process_count() > 1
+            )
     return Session(
         executor=executor,
         parallelism=cfg.get("parallelism") or None,
@@ -117,6 +121,14 @@ def parse(argv=None):
     ap.add_argument("-parallelism", type=int, default=None)
     ap.add_argument("-status", action="store_true", default=None)
     ap.add_argument("-trace", dest="trace_path", default=None)
+    ap.add_argument("-spmd", action="store_true", default=None,
+                    help="multi-host SPMD session (jax.distributed; "
+                         "run the SAME command on every host)")
+    ap.add_argument("-coordinator", default=None,
+                    help="host:port for jax.distributed (omit on TPU "
+                         "pods — auto-detected from the platform)")
+    ap.add_argument("-nprocs", type=int, default=None)
+    ap.add_argument("-procid", type=int, default=None)
     args, rest = ap.parse_known_args(argv)
     if args.local:
         cfg["executor"] = "local"
@@ -126,4 +138,17 @@ def parse(argv=None):
         cfg["status"] = args.status
     if args.trace_path is not None:
         cfg["trace_path"] = args.trace_path
+    if (args.spmd or args.coordinator is not None
+            or args.nprocs is not None or args.procid is not None):
+        # Any multi-host flag implies the SPMD session — a coordinator
+        # address on a non-distributed session would silently run a
+        # single-host job the user believes is a gang.
+        cfg["distributed"] = True
+        cfg["executor"] = "mesh"
+    if args.coordinator is not None:
+        cfg["coordinator"] = args.coordinator
+    if args.nprocs is not None:
+        cfg["num_processes"] = args.nprocs
+    if args.procid is not None:
+        cfg["process_id"] = args.procid
     return make_session(cfg), rest

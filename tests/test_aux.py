@@ -177,6 +177,45 @@ def test_run_cli(tmp_path, monkeypatch, capsys):
     assert "CLI_OK" in capsys.readouterr().out
 
 
+def test_run_cli_pod_launch(tmp_path):
+    """`run -launch 2`: the pod-launch simulation — two real processes
+    of the identical command over a loopback coordinator, an SPMD mesh
+    session spanning both, driver-only output on the coordinator
+    (tools/run.py; the cmd/bigslice one-artifact-everywhere role)."""
+    import os
+    import subprocess
+    import sys
+
+    prog = tmp_path / "prog.py"
+    prog.write_text(
+        "import numpy as np\n"
+        "import bigslice_tpu as bs\n"
+        "from bigslice_tpu.tools.run import current_session\n"
+        "from bigslice_tpu.exec import spmd\n"
+        "import jax\n"
+        "assert jax.process_count() == 2, jax.process_count()\n"
+        "sess = current_session()\n"
+        "assert sess.executor.spmd\n"
+        "keys = np.arange(600, dtype=np.int32) % 11\n"
+        "vals = np.ones(600, np.int32)\n"
+        "res = sess.run(bs.Reduce(bs.Const(2, keys, vals),\n"
+        "                         lambda a, b: a + b))\n"
+        "total = sum(v for _, v in map(tuple, res.rows()))\n"
+        "assert total == 600, total\n"
+        "if spmd.is_coordinator():\n"
+        "    print('POD_OK', total, flush=True)\n"
+    )
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, "-m", "bigslice_tpu.tools.run",
+         "-launch", "2", str(prog)],
+        env=env, capture_output=True, text=True, timeout=240,
+    )
+    assert out.returncode == 0, (out.stdout, out.stderr)
+    assert "POD_OK 600" in out.stdout
+
+
 def test_tarslice(tmp_path):
     from bigslice_tpu.archive import TarSlice
 

@@ -114,13 +114,15 @@ def test_cogroup_large_spilling(tmp_path, monkeypatch):
     assert spills  # the disk path actually ran
 
 
-def test_device_run_sort_matches_lexsort():
-    """The device lax.sort run path and the host lexsort path produce
-    identical orderings (stable, multi-key)."""
+def test_device_run_sort_matches_lexsort(monkeypatch):
+    """The device lax.sort run path (the TPU default — forced here, as
+    CPU backends default to the host lexsort) and the host lexsort
+    path produce identical orderings (stable, multi-key)."""
     from bigslice_tpu.frame.frame import Frame
     from bigslice_tpu.parallel import sortkernel
     from bigslice_tpu.slicetype import Schema
 
+    monkeypatch.setenv("BIGSLICE_DEVICE_SORT", "1")
     rng = np.random.RandomState(3)
     n = sortkernel.DEVICE_SORT_MIN_ROWS + 17
     k1 = rng.randint(0, 50, n).astype(np.int32)
@@ -138,6 +140,15 @@ def test_sorted_by_key_dispatches_to_device(monkeypatch):
     from bigslice_tpu.frame.frame import Frame
     from bigslice_tpu.parallel import sortkernel
     from bigslice_tpu.slicetype import Schema
+
+    # CPU-backend default: the host lexsort (the device kernel is the
+    # TPU default); forced on below to pin the dispatch contract.
+    monkeypatch.delenv("BIGSLICE_DEVICE_SORT", raising=False)
+    n0 = sortkernel.DEVICE_SORT_MIN_ROWS
+    f0 = Frame([np.arange(n0, dtype=np.int32)],
+               Schema([np.int32], prefix=1))
+    assert not sortkernel.device_sortable(f0)
+    monkeypatch.setenv("BIGSLICE_DEVICE_SORT", "1")
 
     called = []
     orig = sortkernel.device_sorted_by_key
