@@ -330,6 +330,50 @@ class _GroupState:
         self.timer: Optional[threading.Timer] = None
 
 
+class _DaemonPool:
+    """Recycling pool of daemon worker threads (see
+    MeshExecutor._group_pool for why not concurrent.futures). Spawns a
+    worker only when no idle one can take the task, up to the cap;
+    beyond it tasks queue. The idle count is advisory (a worker counts
+    itself idle just before blocking on the queue), so a race can at
+    worst spawn an extra worker within the cap — never lose a task."""
+
+    def __init__(self, max_workers: int):
+        import queue
+
+        self._q = queue.SimpleQueue()
+        self._max = max_workers
+        self._nthreads = 0
+        self._idle = 0
+        self._lock = threading.Lock()
+
+    def submit(self, fn, *args) -> None:
+        self._q.put((fn, args))
+        with self._lock:
+            if self._idle == 0 and self._nthreads < self._max:
+                self._nthreads += 1
+                threading.Thread(target=self._loop, daemon=True,
+                                 name="meshgroup").start()
+
+    def _loop(self) -> None:
+        import traceback
+
+        while True:
+            with self._lock:
+                self._idle += 1
+            fn, args = self._q.get()
+            with self._lock:
+                self._idle -= 1
+            try:
+                fn(*args)
+            except BaseException:
+                # Log and keep serving: a dying worker would strand
+                # already-queued tasks (nothing respawns workers until
+                # the next submit), which the bare-thread-per-group
+                # model this pool replaced could never do.
+                traceback.print_exc()
+
+
 class MeshExecutor:
     name = "mesh"
 
@@ -463,6 +507,13 @@ class MeshExecutor:
         self._cancelled: set = set()
         self._ready_cond = threading.Condition(self._lock)
         self._dispatcher: Optional[threading.Thread] = None
+        # Unordered-mode group runs ride a shared daemon-thread pool
+        # (construction is trivial — workers spawn on first submit).
+        # Daemon threads on purpose: a wedged collective must not hang
+        # process shutdown, the liveness contract the per-group daemon
+        # threads this pool replaced provided (concurrent.futures
+        # joins its non-daemon workers at interpreter exit).
+        self._group_workers = _DaemonPool(max_workers=64)
         # Consumer-driven gather (round-2 verdict #3): groups whose
         # outputs are read on host (roots, host-tier consumers,
         # misaligned device consumers) are marked at plan time; only
@@ -736,9 +787,23 @@ class MeshExecutor:
                 # plan head's membership accounting.
                 self._ready_cond.notify_all()
         if complete and not planned:
-            threading.Thread(
-                target=self._run_group, args=(key,), daemon=True
-            ).start()
+            if self.multiprocess:
+                # Cross-process gathers inside a group run can block on
+                # peers indefinitely; a bounded pool could distributed-
+                # deadlock, so multiprocess meshes keep one (unbounded)
+                # thread per group.
+                threading.Thread(
+                    target=self._run_group, args=(key,), daemon=True
+                ).start()
+            else:
+                # Persistent pool, not a fresh thread per group:
+                # iterative drivers complete many small groups per
+                # second and the per-spawn cost is measurable session
+                # overhead. Single-process group executions never wait
+                # on other groups (a group is submitted only when
+                # complete, inputs already stored), so the bounded
+                # pool cannot deadlock.
+                self._group_workers.submit(self._run_group, key)
 
     def device_group_count(self) -> int:
         """How many op groups have run on the device path (diagnostics;
