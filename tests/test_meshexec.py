@@ -835,3 +835,53 @@ def test_wave_stress_64_shards(mesh):
     # Generous wall bound (compile included): catches control-plane
     # regressions an order of magnitude before they hurt.
     assert dt < 60.0, f"wave-stress run took {dt:.1f}s"
+
+
+def test_daemon_pool_recycles_and_survives_exceptions():
+    """The shared group pool: bounded thread count under load, task
+    exceptions never strand queued work, and idle workers retire (the
+    process-global pool must not accumulate threads across sessions)."""
+    import threading
+    import time
+
+    from bigslice_tpu.exec.meshexec import _DaemonPool
+
+    pool = _DaemonPool(max_workers=4, idle_secs=0.2)
+    done = []
+    lock = threading.Lock()
+
+    def work(i):
+        if i % 3 == 0:
+            raise RuntimeError("boom")  # must not kill the worker
+        with lock:
+            done.append(i)
+
+    for i in range(40):
+        pool.submit(work, i)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        with lock:
+            if len(done) == len([i for i in range(40) if i % 3]):
+                break
+        time.sleep(0.01)
+    assert len(done) == len([i for i in range(40) if i % 3])
+    with pool._lock:
+        assert pool._nthreads <= 4
+    # Idle retirement: workers exit after idle_secs without work.
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        with pool._lock:
+            if pool._nthreads == 0:
+                break
+        time.sleep(0.05)
+    with pool._lock:
+        assert pool._nthreads == 0
+    # The pool still serves after full retirement.
+    pool.submit(work, 1)
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        with lock:
+            if done.count(1) == 2:
+                break
+        time.sleep(0.01)
+    assert done.count(1) == 2
