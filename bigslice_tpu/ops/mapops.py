@@ -41,15 +41,55 @@ def _as_schema(out, default_prefix: int = 1) -> Schema:
     return Schema(cols, prefix=min(default_prefix, len(cols)))
 
 
+_TRY_TRACE_CACHE: dict = {}
+_TRY_TRACE_CACHE_MAX = 256
+
+
 def _try_trace(fn: Callable, in_schema: Schema, extra: tuple = (),
                why: list = None):
     """Attempt an abstract trace of fn over scalar avals of the input
     columns (plus unbatched ``extra`` args). Returns the output Schema
     or None if fn must run host-tier; when ``why`` is passed, a reason
     string is appended on None returns that aren't plain
-    untraceability."""
+    untraceability.
+
+    Memoized on (fn, input signature, extra-arg signature) — iterative
+    drivers rebuild the same Map each round with fresh extra VALUES
+    but identical shapes, and the abstract trace dominates op
+    construction. The fn object itself is the key (identity hash, held
+    alive by the entry), matching the downstream jit/program caches'
+    stable-identity contract; recorded `why` reasons replay on hits."""
     if not all(ct.is_device for ct in in_schema):
         return None
+    try:
+        key = (
+            fn,
+            tuple((ct.dtype, ct.shape, ct.is_device) for ct in in_schema),
+            tuple((tuple(np.shape(e)),
+                   np.asarray(e).dtype if not hasattr(e, "dtype") else e.dtype)
+                  for e in extra),
+        )
+        hit = _TRY_TRACE_CACHE.get(key)
+    except Exception:  # unhashable fn/extra: classify uncached
+        key = hit = None
+    if hit is not None:
+        out, msgs = hit
+        if why is not None:
+            why.extend(msgs)
+        return out
+    msgs: list = []
+    out = _try_trace_uncached(fn, in_schema, extra, msgs)
+    if key is not None:
+        _TRY_TRACE_CACHE[key] = (out, tuple(msgs))
+        while len(_TRY_TRACE_CACHE) > _TRY_TRACE_CACHE_MAX:
+            _TRY_TRACE_CACHE.pop(next(iter(_TRY_TRACE_CACHE)))
+    if why is not None:
+        why.extend(msgs)
+    return out
+
+
+def _try_trace_uncached(fn: Callable, in_schema: Schema, extra: tuple,
+                        why: list):
     try:
         import jax
         import jax.numpy as jnp

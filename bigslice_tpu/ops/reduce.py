@@ -26,8 +26,18 @@ from bigslice_tpu.ops.base import Combiner, Dep, Slice, make_name
 from bigslice_tpu.parallel import segment
 
 
+_TRACE_CACHE: dict = {}
+_TRACE_CACHE_MAX = 256
+
+
 def _vals_traceable(fn: Callable, schema: Schema) -> bool:
-    """Can `fn` combine this schema's value columns on device?"""
+    """Can `fn` combine this schema's value columns on device?
+
+    Memoized on (fn, value signature): iterative drivers construct the
+    same Reduce every round, and the abstract trace below costs more
+    than the rest of op construction combined. Keying on the fn OBJECT
+    (identity hash, entry holds it alive — no stale id reuse) matches
+    the kernel caches' stable-identity contract."""
     if not all(ct.is_device for ct in schema):
         return False
     if any(ct.shape != () for ct in schema.key):
@@ -35,6 +45,22 @@ def _vals_traceable(fn: Callable, schema: Schema) -> bool:
         # may be vectors — the kernels route them via permutation
         # gathers (sort_and_segment) and trailing-dim scatters.
         return False
+    try:
+        key = (fn, tuple((ct.dtype, ct.shape) for ct in schema.values))
+        hit = _TRACE_CACHE.get(key)
+    except TypeError:  # unhashable fn: classify uncached
+        key = hit = None
+    if hit is not None:
+        return hit
+    out = _vals_traceable_uncached(fn, schema)
+    if key is not None:
+        _TRACE_CACHE[key] = out
+        while len(_TRACE_CACHE) > _TRACE_CACHE_MAX:
+            _TRACE_CACHE.pop(next(iter(_TRACE_CACHE)))
+    return out
+
+
+def _vals_traceable_uncached(fn: Callable, schema: Schema) -> bool:
     try:
         import jax
 

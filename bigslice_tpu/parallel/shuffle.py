@@ -671,10 +671,17 @@ def unshard_columns(cols: Sequence, counts, capacity: int) -> List[List[np.ndarr
 
 
 def _valid_chunks(c, counts, capacity: int, nshards: int) -> List[np.ndarray]:
+    import jax
+
     from bigslice_tpu.parallel.jitutil import bucket_size
 
     shards = getattr(c, "addressable_shards", None)
     if shards is not None and len(shards) == nshards:
+        # On TPU, slicing the valid prefix on-device before readback is
+        # the point of this path (see unshard_columns); on CPU backends
+        # a whole-shard np.asarray is a plain copy that costs less than
+        # dispatching a device slice program, so slice host-side.
+        device_slice = jax.default_backend() == "tpu"
         by_row = {}
         for sh in shards:
             start = sh.index[0].start or 0
@@ -689,8 +696,15 @@ def _valid_chunks(c, counts, capacity: int, nshards: int) -> List[np.ndarray]:
                         (0,) + tuple(c.shape[1:]), c.dtype
                     ))
                     continue
-                b = min(capacity, bucket_size(k))
-                chunks.append(np.asarray(by_row[s][:b])[:k])
+                if device_slice:
+                    b = min(capacity, bucket_size(k))
+                    chunks.append(np.asarray(by_row[s][:b])[:k])
+                else:
+                    # .copy(): np.asarray over a CPU shard is zero-copy
+                    # and a view would pin the whole capacity-row
+                    # buffer in memoized chunk storage past
+                    # drop_device().
+                    chunks.append(np.asarray(by_row[s])[:k].copy())
             return chunks
     # Host columns / multi-process gathers (already numpy) / unexpected
     # layouts: the plain full-copy path.
