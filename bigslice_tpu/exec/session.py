@@ -276,7 +276,13 @@ class Session:
         # task-level Chrome trace (trace_path).
         self.xprof_dir = xprof_dir
         self._xprof_lock = threading.Lock()
-        self._inv_index = itertools.count(1)
+        # Slice/callable runs draw from the SAME process-global counter
+        # as Func invocations (ops/func._invocation_counter): two
+        # counters would collide on index, merging distinct invocations
+        # in traces and task names.
+        from bigslice_tpu.ops import func as func_mod
+
+        self._inv_index = func_mod._invocation_counter
         self._gate = _InvocationGate()
         executor.start(self)
         self._event("bigslice:sessionStart", executor=executor.name)
@@ -322,6 +328,21 @@ class Session:
             raise typecheck.errorf(
                 "run: expected Func, Slice, or callable, got %s",
                 type(func).__name__,
+            )
+        # Invocation record for the offline trace analyzer
+        # (cmd/slicetrace invocation-category events: index, caller
+        # location, stringified args). Built only when something
+        # consumes events; reprlib bounds the arg stringification
+        # (repr(huge_list)[:64] would materialize the whole string).
+        if self.eventer is not None or self.tracer is not None:
+            import reprlib
+
+            loc = typecheck.caller_location()
+            self._event(
+                f"bigslice:invocation:{inv_index}",
+                inv=inv_index,
+                location=f"{loc[0]}:{loc[1]}" if loc else "?",
+                args=", ".join(reprlib.repr(a) for a in args),
             )
         tasks = compile_mod.Compiler(
             inv_index, machine_combiners=self.machine_combiners

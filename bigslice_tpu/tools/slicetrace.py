@@ -1,8 +1,18 @@
 """Offline trace analyzer (cmd/slicetrace analog).
 
-Reads a session's Chrome trace file (Session(trace_path=...)) and prints
-per-op duration reports with quartiles (cmd/slicetrace/main.go:20-50,
-quartile.go).
+Reads a session's Chrome trace file (``Session(trace_path=...)``) and
+prints, per invocation, the reference's report sections
+(cmd/slicetrace/main.go:100-160, session.go:20-180):
+
+- ``invN:summary`` — caller location and stringified run args (from
+  the ``bigslice:invocation:N`` instant the session records);
+- ``invN:slice`` — per op: shard count, start offset, wall span
+  (first task start → last task end);
+- ``invN:task:quartile`` — per-task duration min/q1/q2/q3/max and
+  total.
+
+Traces from older sessions (no ``inv`` task args) fall back to one
+flat all-ops quartile table.
 
 Usage: python -m bigslice_tpu.tools.slicetrace TRACE.json
 """
@@ -26,33 +36,94 @@ def quartiles(xs: List[float]):
         hi = min(lo + 1, n - 1)
         return xs[lo] + (xs[hi] - xs[lo]) * (i - lo)
 
-    return q(0.25), q(0.5), q(0.75)
+    return xs[0], q(0.25), q(0.5), q(0.75), xs[-1]
+
+
+def _op_rows(tasks: List[dict]):
+    """Aggregate task events (one per run) into per-op rows, ordered by
+    first start."""
+    by_op: Dict[str, List[dict]] = {}
+    for ev in tasks:
+        by_op.setdefault(ev["name"], []).append(ev)
+    rows = []
+    for op, evs in by_op.items():
+        durs = [e["dur"] / 1e3 for e in evs]
+        start = min(e["ts"] for e in evs) / 1e3
+        end = max(e["ts"] + e["dur"] for e in evs) / 1e3
+        shards = max(
+            (e.get("args", {}).get("shards", 0) for e in evs), default=0
+        )
+        rows.append({
+            "op": op, "n": len(evs), "shards": shards, "start": start,
+            "span": end - start, "durs": durs,
+        })
+    rows.sort(key=lambda r: r["start"])
+    return rows
+
+
+def _print_inv(out: List[str], inv, summary: dict, tasks: List[dict]):
+    out.append(f"# inv{inv}:summary")
+    out.append(f"  location  {summary.get('location', '?')}")
+    if summary.get("args"):
+        out.append(f"  args      {summary['args']}")
+    rows = _op_rows(tasks)
+    out.append(f"# inv{inv}:slice")
+    out.append(f"  {'op':<28} {'shards':>6} {'start_ms':>10} "
+               f"{'span_ms':>10}")
+    for r in rows:
+        out.append(f"  {r['op'][:28]:<28} {r['shards']:>6} "
+                   f"{r['start']:>10.2f} {r['span']:>10.2f}")
+    out.append(f"# inv{inv}:task:quartile")
+    out.append(f"  {'op':<28} {'n':>5} {'min_ms':>9} {'q1_ms':>9} "
+               f"{'med_ms':>9} {'q3_ms':>9} {'max_ms':>9} {'total_ms':>10}")
+    for r in rows:
+        mn, q1, q2, q3, mx = quartiles(r["durs"])
+        out.append(
+            f"  {r['op'][:28]:<28} {r['n']:>5} {mn:>9.2f} {q1:>9.2f} "
+            f"{q2:>9.2f} {q3:>9.2f} {mx:>9.2f} {sum(r['durs']):>10.2f}"
+        )
+    out.append("")
 
 
 def analyze(path: str) -> str:
     with open(path) as fp:
         doc = json.load(fp)
-    by_op: Dict[str, List[float]] = {}
-    instants = []
+    tasks_by_inv: Dict[object, List[dict]] = {}
+    summaries: Dict[object, dict] = {}
+    n_tasks = n_instants = 0
     for ev in doc.get("traceEvents", []):
         if ev.get("ph") == "X":
-            by_op.setdefault(ev["name"], []).append(ev["dur"] / 1e3)
+            n_tasks += 1
+            inv = ev.get("args", {}).get("inv")
+            tasks_by_inv.setdefault(inv, []).append(ev)
         elif ev.get("ph") == "i":
-            instants.append(ev["name"])
-    lines = [f"{path}: {sum(len(v) for v in by_op.values())} task runs, "
-             f"{len(instants)} events"]
-    lines.append(
-        f"{'op':<50} {'n':>5} {'q1_ms':>10} {'med_ms':>10} "
-        f"{'q3_ms':>10} {'total_ms':>10}"
-    )
-    for op, durs in sorted(by_op.items(),
-                           key=lambda kv: -sum(kv[1])):
-        q1, q2, q3 = quartiles(durs)
-        lines.append(
-            f"{op[:50]:<50} {len(durs):>5} {q1:>10.2f} {q2:>10.2f} "
-            f"{q3:>10.2f} {sum(durs):>10.2f}"
+            n_instants += 1
+            args = ev.get("args", {})
+            if str(ev.get("name", "")).startswith("bigslice:invocation:"):
+                summaries[args.get("inv")] = args
+    out = [f"{path}: {n_tasks} task runs, {n_instants} events"]
+    known = sorted(k for k in tasks_by_inv if k is not None)
+    for inv in known:
+        _print_inv(out, inv, summaries.get(inv, {}), tasks_by_inv[inv])
+    legacy = tasks_by_inv.get(None)
+    if legacy:
+        # Pre-inv-tagging traces: no invocation identity exists, so
+        # print ONLY the flat all-ops quartile table (a summary/slice
+        # section would be placeholder data).
+        out.append("# all-ops (legacy trace without invocation tags)")
+        out.append(
+            f"  {'op':<28} {'n':>5} {'min_ms':>9} {'q1_ms':>9} "
+            f"{'med_ms':>9} {'q3_ms':>9} {'max_ms':>9} {'total_ms':>10}"
         )
-    return "\n".join(lines)
+        for r in _op_rows(legacy):
+            mn, q1, q2, q3, mx = quartiles(r["durs"])
+            out.append(
+                f"  {r['op'][:28]:<28} {r['n']:>5} {mn:>9.2f} "
+                f"{q1:>9.2f} {q2:>9.2f} {q3:>9.2f} {mx:>9.2f} "
+                f"{sum(r['durs']):>10.2f}"
+            )
+        out.append("")
+    return "\n".join(out)
 
 
 def main(argv=None):
@@ -61,8 +132,11 @@ def main(argv=None):
         print("usage: python -m bigslice_tpu.tools.slicetrace TRACE.json",
               file=sys.stderr)
         return 2
-    for path in argv:
-        print(analyze(path))
+    try:
+        for path in argv:
+            print(analyze(path))
+    except BrokenPipeError:  # `slicetrace t.json | head` is fine
+        pass
     return 0
 
 

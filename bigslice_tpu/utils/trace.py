@@ -99,6 +99,8 @@ class TaskTraceMonitor:
         key = str(task.name)
         if state == TaskState.RUNNING:
             self.tracer.begin(key, task.name.op, pid="tasks",
-                              shard=task.name.shard)
+                              shard=task.name.shard,
+                              shards=task.name.num_shard,
+                              inv=task.name.inv_index)
         elif state in (TaskState.OK, TaskState.ERR, TaskState.LOST):
             self.tracer.end(key, state=state.name)

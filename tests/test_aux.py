@@ -113,6 +113,19 @@ def test_slicetrace_analyzer(tmp_path, capsys):
     assert slicetrace.main([path]) == 0
     out = capsys.readouterr().out
     assert "task runs" in out and "med_ms" in out
+    # Reference-parity sections (cmd/slicetrace/main.go:100-160):
+    # per-invocation summary with the run's caller location, the slice
+    # table, and the quartile table. (Invocation indices are process-
+    # global, so the actual number depends on test order.)
+    import re
+
+    m = re.search(r"# inv(\d+):summary", out)
+    assert m, out
+    inv = m.group(1)
+    assert "test_aux.py" in out  # caller location attribution
+    assert f"# inv{inv}:slice" in out
+    assert f"# inv{inv}:task:quartile" in out
+    assert "shards" in out and "max_ms" in out
 
 
 def test_status_counts():
