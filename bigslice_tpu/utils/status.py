@@ -24,6 +24,13 @@ class Status:
         self._lock = threading.Lock()
         self._task_state: Dict[str, TaskState] = {}
         self._op_of: Dict[str, str] = {}
+        # Per-op wall clock for the LIVE view (slicestatus.go's elapsed
+        # role): first submission → last terminal transition (keeps
+        # ticking while ANY task of the op is non-terminal, tracked by
+        # the live count below).
+        self._op_start: Dict[str, float] = {}
+        self._op_end: Dict[str, float] = {}
+        self._op_live: Dict[str, int] = {}
         # Executor-provided resource telemetry (utils/resources.py):
         # the session wires executor.resource_stats here so render()
         # carries HBM / RSS / combiner gauges next to the task counts
@@ -33,11 +40,26 @@ class Status:
     def set_resources_provider(self, provider) -> None:
         self._resources_provider = provider
 
+    _TERMINAL = (TaskState.OK, TaskState.ERR, TaskState.LOST)
+
     def __call__(self, task, state) -> None:
         with self._lock:
             key = str(task.name)
+            prev = self._task_state.get(key)
             self._task_state[key] = state
             self._op_of[key] = task.name.op
+            op = task.name.op
+            now = time.monotonic()
+            self._op_start.setdefault(op, now)
+            was_live = prev is not None and prev not in self._TERMINAL
+            is_live = state not in self._TERMINAL
+            live = (self._op_live.get(op, 0)
+                    + int(is_live) - int(was_live))
+            self._op_live[op] = live
+            if live > 0:
+                self._op_end.pop(op, None)  # still ticking / resumed
+            elif state in self._TERMINAL:
+                self._op_end[op] = now  # last live task settled
 
     def counts(self) -> Dict[str, Dict[str, int]]:
         with self._lock:
@@ -47,6 +69,15 @@ class Status:
                 d = out.setdefault(op, {})
                 d[state.name] = d.get(state.name, 0) + 1
             return out
+
+    def elapsed(self, op: str) -> float:
+        """Seconds from the op's first submission to its last terminal
+        transition — still ticking while any task is live."""
+        with self._lock:
+            start = self._op_start.get(op)
+            if start is None:
+                return 0.0
+            return self._op_end.get(op, time.monotonic()) - start
 
     def render(self) -> str:
         lines = []
@@ -60,6 +91,7 @@ class Status:
                 line += f", {running} running"
             if err:
                 line += f", {err} failed/lost"
+            line += f" [{self.elapsed(op):.1f}s]"
             lines.append(line)
         provider = self._resources_provider
         if provider is not None:
@@ -88,12 +120,19 @@ class StatusPrinter:
         self._thread.start()
 
     def _loop(self) -> None:
+        import re
+
         last = ""
         while not self._stop.wait(self.interval):
             cur = self.status.render()
-            if cur and cur != last:
+            # Dedup modulo the ticking elapsed field: a long-running op
+            # must not reprint an otherwise-identical block every
+            # interval (non-TTY logs would fill with timestamp-only
+            # changes).
+            key = re.sub(r"\[\d+\.\d+s\]", "[]", cur)
+            if cur and key != last:
                 print(cur, file=self.stream, flush=True)
-                last = cur
+                last = key
 
     def stop(self) -> None:
         self._stop.set()
