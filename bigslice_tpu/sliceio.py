@@ -89,10 +89,134 @@ def merge_reader(readers: Sequence[Reader], schema: Schema) -> Reader:
     """Streaming k-way merge of key-sorted readers (mirrors
     sortio.NewMergeReader, sortio/sort.go:154-216).
 
-    Host-tier merge used when combining spilled/sorted partition streams;
-    the device-tier equivalent is the sort in parallel/segment.py's
-    kernels.
+    Host-tier merge used when combining spilled/sorted partition
+    streams; the device-tier equivalent is the sort in
+    parallel/segment.py's kernels.
+
+    Integer-key schemas take the vectorized watermark merge (batch
+    lexsort of the safely-emittable prefix of every buffer — no
+    per-row Python); float keys (NaN breaks every watermark
+    comparison), object keys, and vector key columns keep the per-row
+    heap merge. Both orders are identical: rows sort by (key, input
+    index, position within input).
     """
+    if schema.prefix >= 1 and all(
+        ct.is_device and ct.shape == ()
+        and np.dtype(ct.dtype).kind in ("i", "u", "b")
+        for ct in schema.key
+    ):
+        yield from _merge_reader_vector(readers, schema)
+        return
+    yield from _merge_reader_heap(readers, schema)
+
+
+def _merge_reader_vector(readers: Sequence[Reader],
+                         schema: Schema) -> Reader:
+    """Batch merge on the WATERMARK rule: wm = the smallest buffered
+    TAIL key among non-exhausted inputs; every buffered row with key
+    STRICTLY below wm is final (any future row of input j is ≥ j's
+    tail ≥ wm), so those rows concatenate and lexsort by (key, input,
+    position) — bit-identical to the per-row heap order. Rows EQUAL to
+    wm must wait: a non-exhausted input whose tail == wm may still
+    produce more of them, and a smaller input index among those must
+    sort first. Inputs at the watermark therefore extend their buffer
+    a frame per round until their tail passes wm (or they exhaust, at
+    which point their bound is +∞) — so buffering is bounded by the
+    longest equal-key run, the same grouped unit the cogroup tier
+    materializes."""
+    prefix = schema.prefix
+    # Per input: a LIST of buffered frames (appended without copying,
+    # so a long equal-key run spanning many frames costs O(run), not
+    # O(run²) re-concat), and a running emit position for the
+    # (key, input, position) tiebreak.
+    bufs: dict = {}  # input index -> [host Frames] (nonempty, sorted)
+    streams = {}
+    exhausted = set()
+    pos0 = {}
+    for j, r in enumerate(readers):
+        f = _next_nonempty(r)
+        if f is not None:
+            bufs[j] = [f.to_host()]
+            streams[j] = r
+            pos0[j] = 0
+        else:
+            exhausted.add(j)
+
+    def tail_key(frames):
+        f = frames[-1]
+        return tuple(c[len(f) - 1] for c in f.cols[:prefix])
+
+    def below_wm(f, wm) -> int:
+        """Length of f's prefix with key strictly below wm."""
+        lt = None
+        eq = np.ones(len(f), dtype=bool)
+        for c, w in zip(f.cols[:prefix], wm):
+            c = np.asarray(c)
+            step = eq & (c < w)
+            lt = step if lt is None else (lt | step)
+            eq = eq & (c == w)
+        return int(lt.sum())  # sorted input: the mask is a prefix
+
+    def pull(j) -> None:
+        nf = _next_nonempty(streams[j])
+        if nf is None:
+            exhausted.add(j)
+        else:
+            bufs.setdefault(j, []).append(nf.to_host())
+
+    while bufs:
+        open_tails = [tail_key(bufs[j]) for j in bufs
+                      if j not in exhausted]
+        wm = min(open_tails) if open_tails else None  # None = +∞
+        parts, tags, poss = [], [], []
+        for j in sorted(bufs):
+            taken = 0
+            frames = bufs[j]
+            while frames:
+                f = frames[0]
+                n = len(f) if wm is None else below_wm(f, wm)
+                if n == 0:
+                    break
+                parts.append(f.slice(0, n))
+                tags.append(np.full(n, j, np.int64))
+                poss.append(np.arange(taken, taken + n, dtype=np.int64)
+                            + pos0[j])
+                taken += n
+                if n < len(f):
+                    frames[0] = f.slice(n, len(f))
+                    break
+                frames.pop(0)
+            pos0[j] += taken
+            if not frames:
+                del bufs[j]
+        if parts:
+            merged = Frame.concat(parts)
+            order = np.lexsort(
+                tuple(reversed([
+                    *(np.asarray(c) for c in merged.cols[:prefix]),
+                    np.concatenate(tags),
+                    np.concatenate(poss),
+                ]))
+            )
+            out = merged.take(order)
+            for i in range(0, len(out), DEFAULT_CHUNK_ROWS):
+                yield out.slice(i, min(i + DEFAULT_CHUNK_ROWS,
+                                       len(out)))
+        if wm is None:
+            assert not bufs  # everything was emitted
+            break
+        # Extend every input sitting AT the watermark (tail == wm):
+        # each pulls one frame (or exhausts) per round — progress. A
+        # non-exhausted input always retains at least its tail row
+        # (tail key ≥ wm and eligibility is strict), so only
+        # tail == wm inputs can be starved of emittable rows.
+        for j in list(bufs):
+            if j not in exhausted and tail_key(bufs[j]) == wm:
+                pull(j)
+
+
+def _merge_reader_heap(readers: Sequence[Reader],
+                       schema: Schema) -> Reader:
     # Buffered cursor per reader: (frames exhausted lazily, row index).
     cursors = []
     for r in readers:

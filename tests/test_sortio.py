@@ -169,3 +169,110 @@ def test_sorted_by_key_dispatches_to_device(monkeypatch):
               Schema([str], prefix=1))
     g.sorted_by_key()
     assert not called
+
+
+def test_merge_reader_vector_matches_heap():
+    """The vectorized watermark merge and the per-row heap merge are
+    bit-identical — same (key, input, position) order — on multi-key
+    numeric streams with duplicate keys across inputs."""
+    from bigslice_tpu import sliceio
+    from bigslice_tpu.frame.frame import Frame
+    from bigslice_tpu.slicetype import Schema
+
+    rng = np.random.RandomState(21)
+    schema = Schema([np.int32, np.int32, np.int32], prefix=2)
+
+    def make_stream(seed, total):
+        r = np.random.RandomState(seed)
+        k1 = np.sort(r.randint(0, 40, total)).astype(np.int32)
+        k2 = r.randint(0, 3, total).astype(np.int32)
+        order = np.lexsort((k2, k1))
+        k1, k2 = k1[order], k2[order]
+        v = np.arange(total, dtype=np.int32) + seed * 1000
+        # ragged chunking
+        frames = []
+        i = 0
+        while i < total:
+            n = int(r.randint(1, 64))
+            frames.append(Frame([k1[i:i+n], k2[i:i+n], v[i:i+n]],
+                                schema))
+            i += n
+        return frames
+
+    streams = [make_stream(s, int(rng.randint(50, 400)))
+               for s in range(5)]
+    a = [f.rows() for f in sliceio._merge_reader_vector(
+        [iter(s) for s in streams], schema)]
+    b = [f.rows() for f in sliceio._merge_reader_heap(
+        [iter(s) for s in streams], schema)]
+    flat_a = [r for fr in a for r in fr]
+    flat_b = [r for fr in b for r in fr]
+    assert flat_a == flat_b
+    assert flat_a == sorted(flat_a, key=lambda r: (r[0], r[1]))
+
+
+def test_merge_reader_dispatch(monkeypatch):
+    """The public merge_reader routes integer scalar keys to the
+    vectorized path; float keys (NaN-unsafe), object keys, and vector
+    key columns stay on the heap path."""
+    from bigslice_tpu import sliceio
+    from bigslice_tpu.frame.frame import Frame, obj_col
+    from bigslice_tpu.slicetype import Schema
+
+    calls = []
+    orig = sliceio._merge_reader_vector
+    monkeypatch.setattr(
+        sliceio, "_merge_reader_vector",
+        lambda r, s: calls.append(1) or orig(r, s),
+    )
+
+    ischema = Schema([np.int32, np.int32], prefix=1)
+    f = Frame([np.array([1, 2], np.int32), np.array([5, 6], np.int32)],
+              ischema)
+    got = list(sliceio.merge_reader([iter([f])], ischema))
+    assert calls and sum(len(x) for x in got) == 2
+
+    calls.clear()
+    fschema = Schema([np.float32, np.int32], prefix=1)
+    ff = Frame([np.array([1.0, np.nan], np.float32),
+                np.array([5, 6], np.int32)], fschema)
+    got = list(sliceio.merge_reader([iter([ff])], fschema))
+    assert not calls  # float keys: heap path (NaN would hang watermarks)
+    assert sum(len(x) for x in got) == 2
+
+    calls.clear()
+    oschema = Schema([str, np.int32], prefix=1)
+    of = Frame([obj_col(["a", "b"]), np.array([5, 6], np.int32)],
+               oschema)
+    list(sliceio.merge_reader([iter([of])], oschema))
+    assert not calls
+
+
+def test_merge_reader_long_equal_run():
+    """An equal-key run spanning many frames merges correctly (the
+    watermark extends the run owner's buffer frame-by-frame) and
+    preserves per-input position order through the run."""
+    from bigslice_tpu import sliceio
+    from bigslice_tpu.frame.frame import Frame
+    from bigslice_tpu.slicetype import Schema
+
+    schema = Schema([np.int32, np.int32], prefix=1)
+
+    def mk(vbase, nframes, rows=7, key=5):
+        out = []
+        for i in range(nframes):
+            out.append(Frame([
+                np.full(rows, key, np.int32),
+                np.arange(rows, dtype=np.int32) + vbase + i * rows,
+            ], schema))
+        out.append(Frame([np.array([9], np.int32),
+                          np.array([vbase + 999], np.int32)], schema))
+        return out
+
+    a = mk(0, 40)
+    b = mk(10000, 3)
+    rows = [r for f in sliceio._merge_reader_vector(
+        [iter(a), iter(b)], schema) for r in f.rows()]
+    heap = [r for f in sliceio._merge_reader_heap(
+        [iter(mk(0, 40)), iter(mk(10000, 3))], schema) for r in f.rows()]
+    assert rows == heap
