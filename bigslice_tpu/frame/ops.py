@@ -99,7 +99,25 @@ def _stable_obj_hash(v) -> int:
 
 
 def hash_host_column(col: np.ndarray, seed: int = 0) -> np.ndarray:
-    """Hash a host (object) column to uint32 on the host."""
+    """Hash a host (object) column to uint32 on the host.
+
+    All-string columns ride the native CRC kernel (bit-identical to
+    the per-row path — both are zlib CRC-32 of the UTF-8 bytes); any
+    non-string (or surrogate-bearing) element falls back to the exact
+    per-row hash."""
+    # Spot-check before materializing a full Python list: mixed/non-str
+    # columns (ints, tuples) must not pay an O(n) copy just for the
+    # kernel to reject them.
+    if len(col) and isinstance(col[0], str) \
+            and isinstance(col[len(col) // 2], str):
+        from bigslice_tpu import native
+
+        if native.enabled():
+            h = native.crc32_strings(
+                col.tolist() if isinstance(col, np.ndarray) else col
+            )
+            if h is not None:
+                return fmix32(h ^ _seed32(seed))
     out = np.fromiter(
         (_stable_obj_hash(v) for v in col), dtype=np.uint32, count=len(col)
     )

@@ -160,6 +160,22 @@ def domains_encode_list(
     return np.frombuffer(codes_b, np.int32), uniques
 
 
+def crc32_strings(lines) -> Optional[np.ndarray]:
+    """uint32 zlib-compatible CRC-32 of each string's UTF-8 bytes —
+    the native lowering of the host hash for str columns. None when
+    the extension is unavailable or any element is not str (including
+    lone-surrogate strings, which need Python's surrogatepass)."""
+    mod = _load_list()
+    if mod is None:
+        return None
+    if not isinstance(lines, list):
+        lines = list(lines)
+    res = mod.crc32_strings(lines)
+    if res is None:
+        return None
+    return np.frombuffer(res, np.uint32)
+
+
 def domains_encode(joined: bytes,
                    n: int) -> Optional[Tuple[np.ndarray, List[str]]]:
     """Dictionary-encode per-row domains over a "\\n"-joined (NOT

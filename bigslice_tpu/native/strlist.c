@@ -164,9 +164,53 @@ static PyObject *domains_encode(PyObject *self, PyObject *args) {
     return out;
 }
 
+/* CRC-32 (IEEE, zlib-compatible) over each string's UTF-8 bytes —
+ * the native lowering of the host tier's _stable_obj_hash for str
+ * columns (frame/ops.py): bit-identical to zlib.crc32(s.encode()).
+ * Returns bytes(uint32[n]) or None when any element is not str. */
+static uint32_t crc_table[256];
+static int crc_table_ready = 0;
+
+static void crc_init(void) {
+    for (uint32_t i = 0; i < 256; i++) {
+        uint32_t c = i;
+        for (int k = 0; k < 8; k++)
+            c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+        crc_table[i] = c;
+    }
+    crc_table_ready = 1;
+}
+
+static PyObject *crc32_strings(PyObject *self, PyObject *args) {
+    PyObject *list;
+    if (!PyArg_ParseTuple(args, "O!", &PyList_Type, &list)) return NULL;
+    if (!crc_table_ready) crc_init();
+    const Py_ssize_t n = PyList_GET_SIZE(list);
+    PyObject *out = PyBytes_FromStringAndSize(NULL, n * 4);
+    if (!out) return NULL;
+    uint32_t *h = (uint32_t *)PyBytes_AS_STRING(out);
+    for (Py_ssize_t r = 0; r < n; r++) {
+        Py_ssize_t blen;
+        const char *bytes =
+            PyUnicode_AsUTF8AndSize(PyList_GET_ITEM(list, r), &blen);
+        if (!bytes) {
+            PyErr_Clear();
+            Py_DECREF(out);
+            Py_RETURN_NONE;
+        }
+        uint32_t c = 0xFFFFFFFFu;
+        for (Py_ssize_t i = 0; i < blen; i++)
+            c = crc_table[(c ^ (uint8_t)bytes[i]) & 0xFF] ^ (c >> 8);
+        h[r] = c ^ 0xFFFFFFFFu;
+    }
+    return out;
+}
+
 static PyMethodDef methods[] = {
     {"domains_encode", domains_encode, METH_VARARGS,
      "domains_encode(list[str]) -> (int32 codes bytes, uniques) | None"},
+    {"crc32_strings", crc32_strings, METH_VARARGS,
+     "crc32_strings(list[str]) -> bytes(uint32[n]) | None"},
     {NULL, NULL, 0, NULL},
 };
 

@@ -356,16 +356,83 @@ def cached_sorted_fold(fn, nkeys: int, nvals: int, init_val,
     return kern
 
 
+HOST_REDUCEAT = {"add": np.add, "max": np.maximum, "min": np.minimum}
+
+
+def grouped_reduceat(key_cols, val_cols, ops):
+    """Segmented reduce of KEY-SORTED host columns: group boundaries
+    from adjacent key change, one classified ``ufunc.reduceat`` per
+    value column. The one shared implementation of the idiom (used by
+    the host combiner here and sortio's streaming reduce) — float sums
+    follow reduceat's blocking, the documented reassociation
+    contract. Returns (keys_at_bounds, reduced_vals)."""
+    n = len(key_cols[0])
+    diff = np.zeros(n, dtype=bool)
+    diff[0] = True
+    for c in key_cols:
+        c = np.asarray(c)
+        diff[1:] |= c[1:] != c[:-1]
+    bounds = np.flatnonzero(diff)
+    keys_out = [np.asarray(c)[bounds] for c in key_cols]
+    vals_out = [
+        HOST_REDUCEAT[op].reduceat(np.asarray(c), bounds, axis=0)
+        for op, c in zip(ops, val_cols)
+    ]
+    return keys_out, vals_out
+
+
+def classified_host_ops(fn, nvals: int, val_cols):
+    """Per-column add/max/min classification for host columns (memoized
+    through dense.classified_ops_cached); None for object columns,
+    empty input, unhashable fns, or unclassified semantics."""
+    if not val_cols or not len(val_cols[0]):
+        return None
+    if any(getattr(c, "dtype", np.dtype(object)) == np.dtype(object)
+           for c in val_cols):
+        return None
+    from bigslice_tpu.parallel.dense import classified_ops_cached
+
+    try:
+        return classified_ops_cached(
+            fn, nvals,
+            tuple(np.asarray(c).dtype for c in val_cols),
+            tuple(np.asarray(c).shape[1:] for c in val_cols),
+        )
+    except TypeError:  # unhashable fn: classify is skipped, not run
+        return None
+
+
 def host_reduce_by_key(key_cols, val_cols, fn, nvals: int):
     """Host-tier fallback keyed reduction (object keys / non-traceable fn).
 
-    Dict-based single pass, mirroring the role (not the mechanics) of the
-    reference's combiningFrame.
+    Combine fns that classify as per-column add/max/min (the probe the
+    dense/hash-aggregate tiers trust) with numeric value columns take
+    a vectorized lexsort + ``reduceat`` pass — string keys compare in
+    C inside np.lexsort, so no per-row Python remains; incomparable
+    key types (lexsort TypeError) and unclassified fns keep the exact
+    dict pass. Output is key-sorted either way (the dict pass sorts at
+    emit), and float sums agree modulo reassociation — the same
+    contract as the device tier's tree scan.
     """
+    n = len(key_cols[0])
+    ops = classified_host_ops(fn, nvals, val_cols)
+    if ops is not None:
+        try:
+            order = np.lexsort(
+                tuple(reversed([np.asarray(c) for c in key_cols]))
+            )
+        except TypeError:
+            order = None  # incomparable keys: dict pass below
+        if order is not None:
+            return grouped_reduceat(
+                [np.asarray(c)[order] for c in key_cols],
+                [np.asarray(c)[order] for c in val_cols],
+                ops,
+            )
+
     cfn = canonical_combine(fn, nvals)
     acc = {}
     order = []
-    n = len(key_cols[0])
     for i in range(n):
         k = tuple(c[i] for c in key_cols)
         v = tuple(c[i] for c in val_cols)

@@ -143,13 +143,41 @@ def reduce_reader(readers: List[sliceio.Reader], schema: Schema,
     them (mirrors sortio.Reduce, sortio/reader.go:36-129): each input has
     at most one row per key; the output has exactly one.
 
-    Streaming: only one row per input is resident at a time.
+    Streaming: only one row per input is resident at a time (per-row
+    path) or one frame plus a one-row carry (vectorized path).
+
+    Combine fns that classify as per-column add/max/min — the SAME
+    probe the dense and hash-aggregate device tiers trust
+    (parallel/dense.classify_combine_ops) — take a vectorized
+    ``ufunc.reduceat`` over each merged frame with a carry row across
+    frame boundaries. Accumulation happens in the COLUMN dtype like
+    the device tier's segmented scan; int add and all max/min are
+    bit-identical to the per-row loop, while float sums agree modulo
+    reassociation (reduceat blocks its additions, the device scan is a
+    tree, and the per-row loop widened to float64 through Python
+    scalar conversion — the usual float-reduce contract). Unclassified
+    fns keep the per-row path.
     """
+    from bigslice_tpu.parallel.dense import classified_ops_cached
     from bigslice_tpu.parallel.segment import canonical_combine
 
     nk = schema.prefix
     nvals = len(schema) - nk
     cfn = canonical_combine(combine_fn, nvals)
+    val_cts = list(schema)[nk:]
+    ops = None
+    if nk >= 1 and all(ct.is_device for ct in val_cts):
+        try:
+            ops = classified_ops_cached(
+                combine_fn, nvals,
+                tuple(ct.dtype for ct in val_cts),
+                tuple(ct.shape for ct in val_cts),
+            )
+        except TypeError:  # unhashable fn
+            ops = None
+    if ops is not None:
+        yield from _reduce_reader_vector(readers, schema, ops)
+        return
     merged = sliceio.merge_reader(readers, schema)
     cur_key = None
     cur_vals = None
@@ -170,3 +198,33 @@ def reduce_reader(readers: List[sliceio.Reader], schema: Schema,
         out_rows.append(cur_key + tuple(cur_vals))
     if out_rows:
         yield Frame.from_rows(out_rows, schema)
+
+
+def _reduce_reader_vector(readers: List[sliceio.Reader], schema: Schema,
+                          ops) -> sliceio.Reader:
+    """Vectorized equal-key combining over the merged stream: per
+    frame, segment.grouped_reduceat (the shared boundary-diff +
+    reduceat idiom) reduces each group; the last group carries into
+    the next frame as a one-row frame."""
+    from bigslice_tpu.parallel.segment import grouped_reduceat
+
+    nk = schema.prefix
+    carry = None  # 1-row Frame holding the possibly-unfinished group
+
+    for f in sliceio.merge_reader(readers, schema):
+        if not len(f):
+            continue
+        f = f.to_host()
+        if carry is not None:
+            f = Frame.concat([carry, f])
+            carry = None
+        keys, vals = grouped_reduceat(f.cols[:nk], f.cols[nk:], ops)
+        out = Frame(keys + vals, schema)
+        # Hold back the last group — its key may continue next frame.
+        if len(out) > 1:
+            yield from sliceio.frame_reader(
+                out.slice(0, len(out) - 1), sliceio.DEFAULT_CHUNK_ROWS
+            )
+        carry = out.slice(len(out) - 1, len(out))
+    if carry is not None and len(carry):
+        yield carry
