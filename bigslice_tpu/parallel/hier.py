@@ -1,0 +1,204 @@
+"""Hierarchical shuffle over a 2-D (DCN × ICI) device mesh.
+
+The 1-D shuffle (parallel/shuffle.py) issues ONE all_to_all over a
+flat axis — ideal when every link is ICI. Multi-pod topologies are
+not flat: chips within a pod slice talk over ICI, pods talk over DCN,
+and a flat all_to_all over the combined mesh sends (D·I)² small
+messages with no regard for which link each crosses. This module is
+the multi-axis re-expression (the "collectives ride ICI, not DCN"
+recipe; SURVEY.md §5.8, design.md future-work #1): shuffle a 2-D mesh
+``Mesh(devices.reshape(D, I), ("dcn", "ici"))`` in TWO stages —
+
+1. **ICI stage**: every device buckets its rows by destination ICI
+   lane and exchanges along the fast intra-group axis. Afterward,
+   device (g, i) holds every row of group g destined to lane i of ANY
+   group.
+2. **DCN stage**: rows bucket by destination group and exchange along
+   the slow axis. Each (source-group, dest-group) pair per lane moves
+   as ONE aggregated message — I× fewer, I× larger DCN transfers than
+   the flat exchange, which is exactly how DCN latency amortizes.
+
+Routing, capacity, slack, and overflow semantics mirror the 1-D
+shuffle: fixed-capacity buckets (static shapes), counts ride a tiny
+all_to_all per stage, skew surfaces as a global overflow count and the
+caller retries with more slack. Both stages reuse the shared routing
+contract (shuffle.partition_ids — the same murmur hash % nparts as
+every other tier) and the shared bucket exchange
+(shuffle.bucket_exchange), so the hierarchical path cannot drift from
+the flat one; a parity test pins per-destination row sets against the
+1-D shuffle on the flattened mesh.
+
+Shard numbering over the 2-D mesh is row-major: global shard
+``s = g * I + i`` lives on device (g, i) — matching
+``mesh.devices.reshape(D, I)`` of the flat device list, so a 1-D
+shuffle over the same devices produces the same per-shard contents.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from bigslice_tpu.parallel.meshutil import get_shard_map
+from bigslice_tpu.parallel.shuffle import (
+    bucket_exchange,
+    partition_ids,
+    route_to_buckets,
+    send_capacity,
+    sortless_routing_default,
+)
+
+# Same lane-count bound as the 1-D shuffle's sortless default: above
+# it the [size, ndest] one-hot's O(n·ndest) work loses to the sort.
+SORTLESS_MAX_LANES = 32
+
+
+def make_hier_shuffle_fn(ndcn: int, nici: int, nkeys: int,
+                         capacity: int,
+                         dcn_axis: str = "dcn", ici_axis: str = "ici",
+                         seed: int = 0,
+                         partition_fn: Optional[Callable] = None,
+                         slack: float = 2.0):
+    """Build the per-device two-stage shuffle body (wrap in shard_map
+    over a ("dcn", "ici") mesh).
+
+    ``body(n, *cols) -> (out_count, overflow, out_cols)`` with
+    ``out_cols`` carrying ``nici * cap1`` rows after stage 1 re-bucketed
+    into ``ndcn * cap2`` rows after stage 2, valid rows compacted to
+    the front. Capacities: cap1 = slack-padded per-lane share of
+    ``capacity``; cap2 = slack-padded per-group share of stage 1's
+    receive buffer.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    nshards = ndcn * nici
+    cap1 = send_capacity(capacity, nici, slack)
+    recv1 = nici * cap1
+    # Stage 2's logical per-group share is capacity/ndcn (a device's
+    # post-stage-1 VALID rows total ~capacity under a uniform hash);
+    # basing cap2 on recv1 would compound slack twice and double the
+    # DCN payload for the same skew tolerance.
+    cap2 = send_capacity(capacity, ndcn, slack)
+    # Per-stage routing lowering: the shared backend default (sort on
+    # real TPU, sortless on CPU meshes) with the lane-count bound.
+    base_sortless = sortless_routing_default()
+    sortless1 = base_sortless and nici <= SORTLESS_MAX_LANES
+    sortless2 = base_sortless and ndcn <= SORTLESS_MAX_LANES
+
+    def body_masked(valid, *cols):
+        size = cols[0].shape[0]
+        keys = cols[:nkeys]
+        # Global destination shard from the SHARED routing contract;
+        # out-of-range partitioner ids park at the drop sentinel.
+        part, bad, _ = partition_ids(
+            keys, nshards, seed, valid=valid, partition_fn=partition_fn,
+        )
+        n_bad = (
+            jnp.int32(0) if bad is None
+            else (bad & valid).sum().astype(np.int32)
+        )
+        routable = part < nshards
+        dest_g = jnp.where(routable, part // np.int32(nici),
+                           np.int32(ndcn))
+        dest_i = jnp.where(routable, part % np.int32(nici),
+                           np.int32(nici))
+
+        # ---- Stage 1: bucket by destination ICI lane, exchange on
+        # the fast axis. dest_g rides along as a payload column.
+        stage1_cols = (dest_g.astype(np.int32),) + tuple(cols)
+        d1, cols1, off1, counts1 = route_to_buckets(
+            dest_i, stage1_cols, nici, sortless1,
+        )
+        in1 = (off1 < cap1) & (d1 < nici)
+        row1 = jnp.where(in1, d1, nici)
+        o1 = jnp.where(in1, off1, 0)
+        send1 = jnp.minimum(counts1, cap1).astype(np.int32)
+        mask1, recv_cols = bucket_exchange(
+            ici_axis, nici, cap1, row1, o1, send1, cols1,
+        )
+        ov1 = jnp.maximum(counts1.max() - cap1, 0)
+
+        # ---- Stage 2: received rows carry their destination group in
+        # the leading column; bucket by it and exchange on DCN. Each
+        # (src group, dst group) pair moves as one message PER ICI
+        # LANE — I messages per pod pair, down from the flat
+        # exchange's I².
+        g2 = jnp.where(mask1, recv_cols[0], np.int32(ndcn))
+        d2, cols2, off2, counts2 = route_to_buckets(
+            g2, tuple(recv_cols[1:]), ndcn, sortless2,
+        )
+        in2 = (d2 < ndcn) & (off2 < cap2)
+        row2 = jnp.where(in2, d2, ndcn)
+        o2 = jnp.where(in2, off2, 0)
+        send2 = jnp.minimum(counts2, cap2).astype(np.int32)
+        mask2, out_cols = bucket_exchange(
+            dcn_axis, ndcn, cap2, row2, o2, send2, cols2,
+        )
+        ov2 = jnp.maximum(counts2.max() - cap2, 0)
+
+        # Global signals: any stage's bucket overflow anywhere, plus
+        # out-of-range partitioner ids (caller raises — user error).
+        total_overflow = lax.psum(
+            lax.psum(ov1 + ov2, ici_axis), dcn_axis
+        )
+        total_bad = lax.psum(lax.psum(n_bad, ici_axis), dcn_axis)
+        return mask2, total_overflow, total_bad, out_cols
+
+    def body(n, *cols):
+        from bigslice_tpu.parallel.segment import compact_by_mask
+
+        size = cols[0].shape[0]
+        valid = jnp.arange(size, dtype=np.int32) < n
+        mask, overflow, bad, out_cols = body_masked(valid, *cols)
+        out_count, out_cols = compact_by_mask(mask, out_cols)
+        return out_count, overflow + bad, list(out_cols)
+
+    body.masked = body_masked
+    return body
+
+
+class HierMeshShuffle:
+    """A compiled two-stage SPMD shuffle over a 2-D ("dcn", "ici")
+    mesh — the multi-pod counterpart of shuffle.MeshShuffle, same
+    call contract: ``__call__(cols, counts) -> (out_cols, out_counts,
+    overflow)`` with columns globally shaped [D*I*capacity, ...]
+    sharded over both axes and counts int32[D*I] (row-major shard s =
+    g * I + i)."""
+
+    def __init__(self, mesh, ncols: int, nkeys: int, capacity: int,
+                 seed: int = 0, partition_fn=None, slack: float = 2.0):
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        shard_map = get_shard_map()
+        dcn_axis, ici_axis = mesh.axis_names
+        ndcn, nici = (mesh.devices.shape[0], mesh.devices.shape[1])
+        self.mesh = mesh
+        self.nshards = ndcn * nici
+        self.capacity = capacity
+        self.out_capacity = ndcn * send_capacity(capacity, ndcn, slack)
+        body = make_hier_shuffle_fn(
+            ndcn, nici, nkeys, capacity, dcn_axis, ici_axis, seed,
+            partition_fn, slack,
+        )
+
+        col_spec = P((dcn_axis, ici_axis))
+        in_specs = (col_spec,) + tuple(col_spec for _ in range(ncols))
+        out_specs = (col_spec, P(),
+                     tuple(col_spec for _ in range(ncols)))
+
+        def stepped(counts, *cols):
+            n = counts[0]
+            out_count, overflow, out_cols = body(n, *cols)
+            return (out_count.reshape(1), overflow, tuple(out_cols))
+
+        self._jitted = jax.jit(
+            shard_map(stepped, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=False)
+        )
+
+    def __call__(self, cols: Sequence, counts):
+        out_counts, overflow, out_cols = self._jitted(counts, *cols)
+        return list(out_cols), out_counts, overflow

@@ -91,6 +91,57 @@ def partition_ids(keys, nparts: int, seed: int, valid=None,
     return part, None, None
 
 
+def route_to_buckets(dest, cols, ndest: int, sortless: bool,
+                     kernel_counts=None):
+    """THE shared bucket-slot computation, both lowerings (used by the
+    1-D shuffle and each stage of the hierarchical 2-D shuffle, so the
+    routings cannot drift):
+
+    - SORTLESS (one-hot cumsum): a row's slot is its running count
+      among same-destination rows — order-preserving, no sort; the
+      CPU-mesh default (a 3-operand sort costs ~40× a linear pass
+      there, BASELINE.md round 5; see sortless_routing_default for the
+      TPU gate).
+    - SORT: rows reorder by destination (payload follows via the
+      carried permutation); slots are arange minus bucket starts.
+
+    ``dest`` int32[size] with values ≥ ndest parking at the drop
+    sentinel. Returns (dest', cols', offsets, counts) where dest'/
+    cols' are the (possibly permuted) rows the offsets refer to and
+    counts int32[ndest] excludes sentinel rows."""
+    import jax.numpy as jnp
+
+    size = dest.shape[0]
+    if sortless:
+        onehot = (dest[:, None]
+                  == jnp.arange(ndest, dtype=np.int32)[None])
+        csum = jnp.cumsum(onehot.astype(np.int32), axis=0)
+        counts = csum[-1]
+        offset = (
+            jnp.take_along_axis(
+                csum,
+                jnp.minimum(dest, np.int32(ndest - 1))[:, None],
+                axis=1,
+            )[:, 0] - 1
+        )
+        return dest, cols, offset, counts
+    from bigslice_tpu.parallel.segment import sort_with_payload
+
+    (s_dest,), s_cols = sort_with_payload((dest,), 1, cols)
+    counts = (
+        kernel_counts if kernel_counts is not None
+        else jnp.bincount(s_dest, length=ndest + 1)[:ndest]
+    )
+    starts = jnp.concatenate(
+        [jnp.zeros(1, np.int32),
+         jnp.cumsum(counts).astype(np.int32)[:-1]]
+    )
+    offset = jnp.arange(size, dtype=np.int32) - jnp.take(
+        starts, jnp.minimum(s_dest, ndest - 1)
+    )
+    return s_dest, s_cols, offset, counts
+
+
 def bucket_exchange(axis: str, nshards: int, send_cap: int, dest_row,
                     dest_off, send_counts, cols):
     """Scatter rows into per-destination send buckets and run the two
@@ -242,45 +293,10 @@ def make_shuffle_fn(nshards: int, nkeys: int, capacity: int,
         else:
             ndest = nparts
 
-        if sortless:
-            # SORTLESS routing: a row's bucket slot is its running
-            # count among same-destination rows — one [size, ndest]
-            # one-hot cumsum (order-preserving, so within-bucket row
-            # order stays the arrival order), no sort at all. On the
-            # sort-dominated CPU-mesh roofline (BASELINE.md round 5: a
-            # 3-operand sort costs ~40x a linear pass at these sizes)
-            # this removes the combinerless shuffle's only sort; see
-            # sortless_routing_default for the TPU gate.
-            onehot = (part[:, None] == jnp.arange(ndest,
-                                                  dtype=np.int32)[None])
-            csum = jnp.cumsum(onehot.astype(np.int32), axis=0)
-            counts = csum[-1]
-            offset = (
-                jnp.take_along_axis(
-                    csum,
-                    jnp.minimum(part, np.int32(ndest - 1))[:, None],
-                    axis=1,
-                )[:, 0] - 1
-            )
-            s_part, s_cols = part, cols
-        else:
-            # Sort rows by destination; payload rides along (vector
-            # columns follow a carried permutation).
-            from bigslice_tpu.parallel.segment import sort_with_payload
-
-            (s_part,), s_cols = sort_with_payload((part,), 1, cols)
-            counts = (
-                kernel_counts
-                if kernel_counts is not None and not waved
-                else jnp.bincount(s_part, length=ndest + 1)[:ndest]
-            )
-            starts = jnp.concatenate(
-                [jnp.zeros(1, np.int32),
-                 jnp.cumsum(counts).astype(np.int32)[:-1]]
-            )
-            offset = jnp.arange(size, dtype=np.int32) - jnp.take(
-                starts, jnp.minimum(s_part, ndest - 1)
-            )
+        s_part, s_cols, offset, counts = route_to_buckets(
+            part, cols, ndest, sortless,
+            kernel_counts=kernel_counts if not waved else None,
+        )
 
         # Scatter into (nshards, send_cap) send buckets; rows beyond
         # capacity (or invalid) drop — reported via `overflow`.
@@ -616,9 +632,12 @@ def shard_columns(mesh, cols: Sequence[np.ndarray], counts: Sequence[int],
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    axis = mesh_axis(mesh)
     nshards = mesh.devices.size
-    sharding = NamedSharding(mesh, P(axis))
+    # Shard axis 0 over EVERY mesh axis: 1-D meshes get the usual
+    # P("shards"); 2-D (dcn, ici) meshes get P(("dcn","ici")) — shard
+    # s lives on mesh.devices.flat[s] (row-major) either way, so the
+    # flat and hierarchical shuffles see identical placements.
+    sharding = NamedSharding(mesh, P(tuple(mesh.axis_names)))
     multi = is_multiprocess_mesh(mesh)
     if multi:
         pid = jax.process_index()

@@ -192,6 +192,37 @@ def _programs(mesh, axis: str):
         [S((nmesh * SIZE, d_), f32), S((k_, d_), f32)],
     )
 
+    # 8b. Hierarchical 2-D (DCN × ICI) shuffle: the two-stage exchange
+    # over a (nmesh/4, 4) grid of the same topology devices — proves
+    # the multi-pod collective pattern (ici all_to_all + aggregated
+    # dcn all_to_all) lowers and compiles for TPU.
+    if nmesh % 4 == 0 and nmesh >= 8:
+        from jax.sharding import Mesh as _Mesh
+
+        from bigslice_tpu.parallel import hier
+
+        grid = _Mesh(mesh.devices.reshape(nmesh // 4, 4),
+                     ("dcn", "ici"))
+        hier_body = hier.make_hier_shuffle_fn(
+            nmesh // 4, 4, 1, SIZE
+        )
+
+        def shuffle_hier(counts, k, v):
+            c, ov, out = hier_body(counts[0], k, v)
+            return (c.reshape(1), out[0], out[1], ov)
+
+        gspec = P(("dcn", "ici"))
+        progs["shuffle_hier"] = (
+            jax.jit(shard_map(
+                shuffle_hier, mesh=grid,
+                in_specs=(gspec, gspec, gspec),
+                out_specs=(gspec, gspec, gspec, P()),
+                check_rep=False,
+            )),
+            [S((nmesh,), i32), S((nmesh * SIZE,), i32),
+             S((nmesh * SIZE,), i32)],
+        )
+
     # 9. Mosaic Pallas: the fused hash+validity+histogram kernel.
     from bigslice_tpu.parallel import pallas_kernels as pk
 
