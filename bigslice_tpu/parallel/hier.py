@@ -159,6 +159,84 @@ def make_hier_shuffle_fn(ndcn: int, nici: int, nkeys: int,
     return body
 
 
+class HierMeshReduceByKey:
+    """Keyed reduction over a 2-D ("dcn", "ici") mesh: map-side
+    segmented combine → two-stage hierarchical shuffle → reduce-side
+    combine, one jitted SPMD program — the multi-pod counterpart of
+    shuffle.MeshReduceByKey, composed from the same masked kernels
+    (the combine stages are segment.make_segmented_reduce_masked, the
+    exchange is make_hier_shuffle_fn.masked), so its results are the
+    per-shard row sets the flat reduce produces.
+
+    Known follow-up: the map-side combine is UNFUSED — on sort-routing
+    backends (the TPU default) it pays its own (validity, keys) sort
+    before stage 1's destination sort, where the flat path's
+    make_combine_shuffle_fn serves both with one sort by (validity,
+    destination, keys); the same fusion is valid here (equal keys
+    share dest_i) and is the next step if hier reduces become hot."""
+
+    def __init__(self, mesh, nkeys: int, nvals: int, capacity: int,
+                 combine_fn: Callable, seed: int = 0,
+                 slack: float = 2.0):
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        from bigslice_tpu.parallel import segment
+
+        shard_map = get_shard_map()
+        dcn_axis, ici_axis = mesh.axis_names
+        ndcn, nici = mesh.devices.shape
+        self.mesh = mesh
+        self.nshards = ndcn * nici
+        self.capacity = capacity
+        self.out_capacity = ndcn * send_capacity(capacity, ndcn, slack)
+        ncols = nkeys + nvals
+        cfn = segment.canonical_combine(combine_fn, nvals)
+        combine_local = segment.make_segmented_reduce_masked(
+            nkeys, nvals, cfn, compact=False
+        )
+        combine_final = segment.make_segmented_reduce_masked(
+            nkeys, nvals, cfn, compact=True
+        )
+        body = make_hier_shuffle_fn(
+            ndcn, nici, nkeys, capacity, dcn_axis, ici_axis, seed,
+            slack=slack,
+        )
+
+        def stepped(counts, *cols):
+            import jax.numpy as jnp
+
+            n = counts[0]
+            size = cols[0].shape[0]
+            mask0 = jnp.arange(size, dtype=np.int32) < n
+            keep, k1, v1 = combine_local(mask0, cols[:nkeys],
+                                         cols[nkeys:])
+            mask2, overflow, _bad, out_cols = body.masked(
+                keep, *(tuple(k1) + tuple(v1))
+            )
+            n3, k3, v3 = combine_final(
+                mask2, tuple(out_cols[:nkeys]), tuple(out_cols[nkeys:])
+            )
+            return (n3.reshape(1), overflow, tuple(k3) + tuple(v3))
+
+        col_spec = P((dcn_axis, ici_axis))
+        in_specs = (col_spec,) + tuple(col_spec for _ in range(ncols))
+        out_specs = (col_spec, P(),
+                     tuple(col_spec for _ in range(ncols)))
+        self._jitted = jax.jit(
+            shard_map(stepped, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=False)
+        )
+
+    def __call__(self, key_cols: Sequence, val_cols: Sequence, counts):
+        nkeys = len(key_cols)
+        out_counts, overflow, cols = self._jitted(
+            counts, *(list(key_cols) + list(val_cols))
+        )
+        return (list(cols[:nkeys]), list(cols[nkeys:]), out_counts,
+                overflow)
+
+
 class HierMeshShuffle:
     """A compiled two-stage SPMD shuffle over a 2-D ("dcn", "ici")
     mesh — the multi-pod counterpart of shuffle.MeshShuffle, same

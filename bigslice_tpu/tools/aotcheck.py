@@ -223,6 +223,35 @@ def _programs(mesh, axis: str):
              S((nmesh * SIZE,), i32)],
         )
 
+        # 8c. The COMPOSED hier reduce (map combine → two-stage
+        # exchange → final combine) — the exact program
+        # HierMeshReduceByKey jits, so "TPU-AOT-proven" covers the
+        # composition, not just the exchange.
+        h_local = segment.make_segmented_reduce_masked(
+            1, 1, cfn, compact=False
+        )
+        h_final = segment.make_segmented_reduce_masked(
+            1, 1, cfn, compact=True
+        )
+
+        def reduce_hier(counts, k, v):
+            m = jnp.arange(SIZE, dtype=np.int32) < counts[0]
+            keep, k1, v1 = h_local(m, (k,), (v,))
+            m2, ov, _bad, oc = hier_body.masked(keep, k1[0], v1[0])
+            n3, k3, v3 = h_final(m2, (oc[0],), (oc[1],))
+            return (n3.reshape(1), k3[0], v3[0], ov)
+
+        progs["reduce_hier"] = (
+            jax.jit(shard_map(
+                reduce_hier, mesh=grid,
+                in_specs=(gspec, gspec, gspec),
+                out_specs=(gspec, gspec, gspec, P()),
+                check_rep=False,
+            )),
+            [S((nmesh,), i32), S((nmesh * SIZE,), i32),
+             S((nmesh * SIZE,), i32)],
+        )
+
     # 9. Mosaic Pallas: the fused hash+validity+histogram kernel.
     from bigslice_tpu.parallel import pallas_kernels as pk
 

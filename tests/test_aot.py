@@ -1,7 +1,7 @@
 """AOT TPU compile checks (tools/aotcheck.py): the device tier must
 lower + compile for a real TPU topology without hardware.
 
-The full sweep (`python bench.py --aot-check`) covers all 11 programs
+The full sweep (`python bench.py --aot-check`) covers all 12 programs
 and records cost stats in AOT_TPU.json; here we compile a fast subset
 per-test so a Mosaic or collective-lowering regression fails CI in
 seconds, not on the first live chip.

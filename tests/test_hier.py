@@ -87,3 +87,50 @@ def test_hier_custom_partitioner(meshes):
     counts_host = np.asarray(cnt)
     assert counts_host[:3].sum() == n * per
     assert all(c == 0 for c in counts_host[3:])
+
+
+def test_hier_reduce_matches_oracle_and_flat(meshes):
+    """HierMeshReduceByKey: combine → two-stage shuffle → combine over
+    the 2-D grid equals both the Python oracle and the flat
+    MeshReduceByKey's per-shard results."""
+    flat, grid = meshes
+    rng = np.random.RandomState(12)
+    cap = 512
+    per = 150
+    n = 8
+    kc = [rng.randint(0, 41, per).astype(np.int32) for _ in range(n)]
+    vc = [rng.randint(0, 10, per).astype(np.int32) for _ in range(n)]
+
+    def add(a, b):
+        return a + b
+
+    cols_g, counts_g = shuffle_mod.shard_columns(
+        grid, [kc, vc], [per] * n, cap
+    )
+    red_g = hier.HierMeshReduceByKey(grid, nkeys=1, nvals=1,
+                                     capacity=cap, combine_fn=add)
+    kg, vg, cnt_g, ov_g = red_g([cols_g[0]], [cols_g[1]], counts_g)
+    assert int(ov_g) == 0
+
+    cols_f, counts_f = shuffle_mod.shard_columns(
+        flat, [kc, vc], [per] * n, cap
+    )
+    red_f = shuffle_mod.MeshReduceByKey(flat, nkeys=1, nvals=1,
+                                        capacity=cap, combine_fn=add)
+    kf, vf, cnt_f, ov_f = red_f([cols_f[0]], [cols_f[1]], counts_f)
+    assert int(ov_f) == 0
+
+    g_rows = _shard_rows(kg + vg, cnt_g, red_g.out_capacity, n)
+    f_rows = _shard_rows(kf + vf, cnt_f, red_f.out_capacity, n)
+    assert g_rows == f_rows
+
+    oracle = {}
+    for k, v in zip(np.concatenate(kc).tolist(),
+                    np.concatenate(vc).tolist()):
+        oracle[k] = oracle.get(k, 0) + v
+    got = {}
+    for shard in g_rows:
+        for k, v in shard:
+            assert k not in got
+            got[k] = v
+    assert got == oracle
