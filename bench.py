@@ -217,6 +217,62 @@ def reduce_e2e_bench(keys, vals, iters: int = 3, dense_keys=None,
     return len(keys) / best
 
 
+# ----------------------------------------------------------- reduce-wave
+
+def reduce_wave_bench(keys, vals, num_shards: int, iters: int = 3,
+                      pipelined: bool = True):
+    """Wave-streamed keyed Reduce (S >= 4×N shards on the N-device
+    mesh): the beyond-HBM shape, ceil(S/N) waves per op group.
+
+    ``pipelined=False`` pins every wave-pipeline feature off —
+    prefetch_depth=0 (strictly serial staging), no buffer donation, no
+    consumer-side subid pre-split — which is exactly the pre-pipeline
+    executor's behavior: the BENCH_pr01 "before". ``pipelined=True``
+    is the shipped default (prefetch depth 1, donated wave buffers,
+    one-pass subid split so each consumer wave reads only its own
+    partition's rows instead of re-scanning the full receive buffer
+    W times). On a many-core host the prefetch overlap adds on top;
+    on a 1-vCPU runner the split + donation carry the win (overlap
+    needs a second core to stand on)."""
+    import bigslice_tpu as bs
+    from bigslice_tpu.exec.meshexec import MeshExecutor
+    from bigslice_tpu.exec.session import Session
+
+    mesh = _mesh()
+    if pipelined:
+        ex = MeshExecutor(mesh, prefetch_depth=1)
+    else:
+        ex = MeshExecutor(mesh, prefetch_depth=0,
+                          donate_buffers=False, subid_split=False)
+    sess = Session(executor=ex)
+
+    def add(a, b):
+        return a + b
+
+    def run_once():
+        r = bs.Reduce(bs.Const(num_shards, keys, vals), add)
+        res = sess.run(r)
+        total = 0
+        for f in res.frames():
+            total += len(f)
+        res.discard()
+        return total
+
+    run_once()  # warm compile caches
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        distinct = run_once()
+        times.append(time.perf_counter() - t0)
+    if sess.executor.device_group_count() == 0:
+        raise RuntimeError("wave reduce never engaged the device path")
+    best = min(times)
+    note(f"reduce_wave[{'pipelined' if pipelined else 'serial'}]: "
+         f"{distinct} distinct keys, {num_shards} shards on "
+         f"{mesh.devices.size} devices, best {best*1e3:.0f} ms")
+    return len(keys) / best
+
+
 # ------------------------------------------------------------------ join
 
 def join_key_space(n_rows: int) -> int:
@@ -721,6 +777,26 @@ def run_mode(mode: str, size, fallback: bool) -> None:
         dev = reduce_e2e_bench(keys, vals, dense_keys=n_keys)
         emit("reduce_by_key_dense_e2e_rows_per_sec", dev, "rows/sec",
              base)
+    elif mode == "reduce-wave":
+        # Wave streaming: S = 4×N shards force ceil(S/N)=4 waves
+        # through the device per group, keys drawn from a genuinely
+        # NON-dense space (2^20 — the auto-dense probe declines, the
+        # generic pipeline runs). vs_baseline here is the pre-pipeline
+        # SERIAL wave executor (prefetch 0, no donation, no subid
+        # split), not the CPU — the number that judges the overlapped
+        # wave pipeline itself.
+        import jax as _jax
+
+        n_rows = size or (1 << 22 if fallback else 1 << 25)
+        S = 4 * max(1, len(_jax.devices()))
+        rng = np.random.RandomState(42)
+        keys = rng.randint(0, 1 << 20, n_rows).astype(np.int32)
+        vals = np.ones(n_rows, dtype=np.int32)
+        serial = reduce_wave_bench(keys, vals, S, pipelined=False)
+        piped = reduce_wave_bench(keys, vals, S, pipelined=True)
+        note(f"reduce_wave: serial {serial:,.0f} rows/s, pipelined "
+             f"{piped:,.0f} rows/s → {piped/serial:.2f}x")
+        emit("reduce_wave_e2e_rows_per_sec", piped, "rows/sec", serial)
     elif mode == "reduce-kernel":
         n_rows = size or (1 << 21 if fallback else 1 << 24)
         rng = np.random.RandomState(42)
@@ -782,15 +858,16 @@ def run_mode(mode: str, size, fallback: bool) -> None:
 # Matrix order: the honest e2e reduce headline runs LAST because the
 # driver parses the tail JSON line (VERDICT r2 #1). Fast sizes so the
 # full sweep stays bounded even on the 1-vCPU fallback.
-MATRIX = ("reduce-sort", "reduce-dense", "join", "join-dense",
-          "wordcount", "sortshuffle", "cogroup", "kmeans", "attention",
-          "reduce")
+MATRIX = ("reduce-sort", "reduce-dense", "reduce-wave", "join",
+          "join-dense", "wordcount", "sortshuffle", "cogroup",
+          "kmeans", "attention", "reduce")
 
 # Fast matrix sizes per mode (None → the mode's own fallback default).
 _MATRIX_SIZES = {
     "reduce": 1 << 20,
     "reduce-sort": 1 << 20,
     "reduce-dense": 1 << 20,
+    "reduce-wave": 1 << 20,
     "join": 1 << 17,
     "join-dense": 1 << 17,
     "wordcount": 1 << 17,
@@ -841,9 +918,9 @@ def main():
     fallback = backend in ("cpu", "cpu-fallback")
     args = sys.argv[1:]
     known = ("reduce", "reduce-sort", "reduce-nohash", "reduce-dense",
-             "reduce-kernel", "join", "join-dense", "join-kernel",
-             "wordcount", "sortshuffle", "cogroup", "kmeans",
-             "attention", "matrix")
+             "reduce-wave", "reduce-kernel", "join", "join-dense",
+             "join-kernel", "wordcount", "sortshuffle", "cogroup",
+             "kmeans", "attention", "matrix")
     mode = "matrix"
     if args and args[0] in known:
         mode = args.pop(0)

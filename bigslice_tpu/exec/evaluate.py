@@ -36,6 +36,27 @@ from bigslice_tpu.exec.task import (
 
 MAX_CONSECUTIVE_LOST = 5  # exec/eval.go:30
 
+# Executor phase markers for the overlapped wave pipeline
+# (exec/meshexec.py): emitted when a wave's inputs finish staging on the
+# prefetcher and when its program dispatches. Out-of-band with respect
+# to task STATE — a waved task stays RUNNING across every phase — so
+# they ride a separate monitor channel (notify_phase) instead of the
+# (task, state) transition callback.
+PHASE_WAVE_PREFETCH = "wavePrefetch"
+PHASE_WAVE_COMPUTE = "waveCompute"
+
+
+def notify_phase(monitor, task, phase: str, wave: int) -> None:
+    """Deliver an executor phase event to a monitor that opts in by
+    exposing an ``on_phase(task, phase, wave)`` attribute (see
+    utils.status.chain_monitors, which forwards to every opted-in
+    member). Monitors that only understand (task, state) transitions
+    are untouched — the phase channel is additive, so existing status
+    displays and tracers keep working unmodified."""
+    fn = getattr(monitor, "on_phase", None)
+    if fn is not None:
+        fn(task, phase, wave)
+
 # Safety-net sweep interval: the event-driven loop needs no polling, but
 # a lost wakeup (executor dropping a task without a transition) must
 # fail loudly rather than hang. Coarse on purpose.

@@ -46,6 +46,16 @@ class Store:
     def read(self, name: TaskName, partition: int) -> Iterator[Frame]:
         raise NotImplementedError
 
+    def prefetch(self, name: TaskName, partition: int) -> None:
+        """Advisory read-ahead hint: a later ``read`` of this partition
+        is likely (the mesh executor's wave prefetcher hints upcoming
+        waves' host-tier deps). Best-effort and allowed to do nothing —
+        the default no-op is correct for memory-resident tiers; the
+        FileStore warms the partition into a bounded host cache off the
+        caller's thread so the wave-staging read doesn't stall on
+        disk/GCS latency."""
+        return None
+
     def discard(self, name: TaskName) -> None:
         raise NotImplementedError
 
@@ -88,8 +98,30 @@ class FileStore(Store):
 
     streaming = True
 
+    # Warm-cache bound: at most this many prefetched partitions held in
+    # host memory (FIFO) — read-ahead for a handful of upcoming waves,
+    # never an unbounded mirror of the spilled dataset. The pending
+    # queue shares the bound: hints beyond it drop (advisory contract).
+    PREFETCH_CACHE_MAX = 32
+
     def __init__(self, prefix: str):
         self.prefix = prefix
+        self._warm_lock = threading.Lock()
+        # (name, partition) -> list[Frame]. Failed prefetches insert
+        # nothing: read() falls through to the direct path, which
+        # raises the authoritative error.
+        self._warm: Dict[Tuple[TaskName, int], object] = {}
+        self._warm_pending: set = set()
+        # Per-name generation, bumped by discard() and put(): an
+        # in-flight prefetch that started before the bump must NOT
+        # insert its (now stale) frames — a recomputed task's fresh
+        # output would silently lose to pre-discard data.
+        self._warm_gen: Dict[TaskName, int] = {}
+        # ONE worker drains hints sequentially (spawned on first use,
+        # retired when idle): read-ahead must not fan out one thread
+        # per partition and hammer disk/GCS with unbounded concurrency.
+        self._warm_queue: list = []
+        self._warm_worker_live = False
 
     def _path(self, name: TaskName, partition: int) -> str:
         return fileio.join(
@@ -101,6 +133,10 @@ class FileStore(Store):
         )
 
     def put(self, name, partition, frames):
+        with self._warm_lock:
+            # New contents supersede anything warmed or in flight.
+            self._warm_gen[name] = self._warm_gen.get(name, 0) + 1
+            self._warm.pop((name, partition), None)
         with fileio.atomic_write(self._path(name, partition)) as fp:
             for f in frames:
                 fp.write(codec.encode_frame(f))
@@ -108,7 +144,58 @@ class FileStore(Store):
     def committed(self, name, partition):
         return fileio.exists(self._path(name, partition))
 
+    def prefetch(self, name, partition):
+        key = (name, partition)
+        spawn = False
+        with self._warm_lock:
+            if (key in self._warm or key in self._warm_pending
+                    or len(self._warm_pending) >=
+                    self.PREFETCH_CACHE_MAX):
+                return  # advisory: saturated read-ahead just drops
+            self._warm_pending.add(key)
+            self._warm_queue.append((key, self._warm_gen.get(name, 0)))
+            if not self._warm_worker_live:
+                self._warm_worker_live = True
+                spawn = True
+        if spawn:
+            threading.Thread(
+                target=self._prefetch_loop, daemon=True,
+                name="filestore-prefetch",
+            ).start()
+
+    def _prefetch_loop(self) -> None:
+        while True:
+            with self._warm_lock:
+                if not self._warm_queue:
+                    self._warm_worker_live = False
+                    return
+                key, gen = self._warm_queue.pop(0)
+            name, partition = key
+            try:
+                frames = list(self._read_direct(name, partition))
+            except BaseException:  # noqa: BLE001 — read() re-raises
+                frames = None      # the authoritative error itself
+            with self._warm_lock:
+                self._warm_pending.discard(key)
+                if (frames is not None
+                        and self._warm_gen.get(name, 0) == gen):
+                    # Generation unchanged: no discard()/put() raced
+                    # this read — the frames are current.
+                    self._warm[key] = frames
+                    while len(self._warm) > self.PREFETCH_CACHE_MAX:
+                        self._warm.pop(next(iter(self._warm)))
+
     def read(self, name, partition):
+        # One-shot warm-cache hit: prefetched frames serve the read
+        # without touching the file again; the entry is consumed (a
+        # re-read streams from the file, which stays authoritative).
+        with self._warm_lock:
+            warm = self._warm.pop((name, partition), None)
+        if warm is not None:
+            return iter(warm)
+        return self._read_direct(name, partition)
+
+    def _read_direct(self, name, partition):
         path = self._path(name, partition)
         try:
             fp = fileio.open_read(path)
@@ -125,6 +212,12 @@ class FileStore(Store):
         return stream()
 
     def discard(self, name):
+        with self._warm_lock:  # never serve a discarded task's frames
+            # Bump the generation: an in-flight prefetch that read the
+            # files BEFORE this discard must not repopulate the cache.
+            self._warm_gen[name] = self._warm_gen.get(name, 0) + 1
+            for k in [k for k in self._warm if k[0] == name]:
+                del self._warm[k]
         path = self._path(name, 0)
         d = (path.rsplit("/", 1)[0] if fileio.is_url(path)
              else os.path.dirname(path))

@@ -382,3 +382,87 @@ def make_hash_join_align(nkeys: int, ops_a: Sequence[str],
         return mask, list(out_keys) + ta + tb, ov
 
     return align
+
+
+class MeshHashReduceByKey:
+    """Mesh-wide keyed reduction with ZERO sorts, as one jitted SPMD
+    program: fused hash combine + region all_to_all (map side) →
+    claim-cascade re-combine (reduce side) → mask compaction. The
+    standalone-kernel counterpart of shuffle.MeshReduceByKey for
+    classified combine ops ('add'|'max'|'min' per value column) — the
+    same lowering the mesh executor fuses into op groups, exposed at
+    kernel granularity for benches and wave-streaming drivers.
+
+    ``__call__(key_cols, val_cols, counts)`` with columns globally
+    shaped [nshards*capacity, ...] sharded on axis 0 and counts
+    int32[nshards]; returns (key_cols, val_cols, out_counts, overflow).
+    ``overflow`` > 0 means a claim cascade failed (load factor ~1 /
+    adversarial keys): discard the result and re-run on the sort path
+    (shuffle.MeshReduceByKey) — the executor's fallback contract.
+
+    ``donate=True`` donates the staged inputs to the program
+    (jitutil.jit_maybe_donate): steady-state wave streaming re-stages
+    fresh columns per call and reuses their HBM here.
+    """
+
+    def __init__(self, mesh, nkeys: int, nvals: int, capacity: int,
+                 ops: Sequence[str], seed: int = 0,
+                 donate: bool = False):
+        from jax.sharding import PartitionSpec as P
+
+        from bigslice_tpu.parallel.jitutil import jit_maybe_donate
+        from bigslice_tpu.parallel.meshutil import (
+            get_shard_map,
+            mesh_axis,
+        )
+        from bigslice_tpu.parallel.segment import compact_by_mask
+
+        shard_map = get_shard_map()
+        axis = mesh_axis(mesh)
+        nshards = mesh.devices.size
+        self.mesh = mesh
+        self.nshards = nshards
+        self.capacity = capacity
+        ncols = nkeys + nvals
+        fused = make_hash_combine_shuffle(
+            nshards, nkeys, nvals, ops, axis, seed
+        )
+        recv_rows = nshards * combine_region_size(capacity, nshards)
+        self.out_capacity = bucket_size(recv_rows)
+        final = make_hash_combine(nkeys, nvals, ops, seed)
+
+        def stepped(counts, *cols):
+            import jax.numpy as jnp
+            from jax import lax
+
+            n = counts[0]
+            size = cols[0].shape[0]
+            mask0 = jnp.arange(size, dtype=np.int32) < n
+            recv_mask, ov1, _bad, out_cols = fused.masked(mask0, *cols)
+            mask2, k2, v2, ov2 = final(
+                recv_mask, tuple(out_cols[:nkeys]),
+                tuple(out_cols[nkeys:]),
+            )
+            out_n, packed = compact_by_mask(
+                mask2, list(k2) + list(v2)
+            )
+            overflow = ov1 + lax.psum(ov2, axis)
+            return out_n.reshape(1), overflow, tuple(packed)
+
+        col_spec = P(axis)
+        in_specs = (col_spec,) + tuple(col_spec for _ in range(ncols))
+        out_specs = (col_spec, P(),
+                     tuple(col_spec for _ in range(ncols)))
+        self._jitted = jit_maybe_donate(
+            shard_map(stepped, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=False),
+            tuple(range(1 + ncols)) if donate else (),
+        )
+
+    def __call__(self, key_cols: Sequence, val_cols: Sequence, counts):
+        nkeys = len(key_cols)
+        out_counts, overflow, cols = self._jitted(
+            counts, *(list(key_cols) + list(val_cols))
+        )
+        return (list(cols[:nkeys]), list(cols[nkeys:]), out_counts,
+                overflow)

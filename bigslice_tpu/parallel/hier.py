@@ -40,9 +40,11 @@ from typing import Callable, Optional, Sequence
 
 import numpy as np
 
+from bigslice_tpu.parallel.jitutil import jit_maybe_donate
 from bigslice_tpu.parallel.meshutil import get_shard_map
 from bigslice_tpu.parallel.shuffle import (
     bucket_exchange,
+    make_combine_shuffle_fn,
     partition_ids,
     route_to_buckets,
     send_capacity,
@@ -52,6 +54,31 @@ from bigslice_tpu.parallel.shuffle import (
 # Same lane-count bound as the 1-D shuffle's sortless default: above
 # it the [size, ndest] one-hot's O(n·ndest) work loses to the sort.
 SORTLESS_MAX_LANES = 32
+
+
+def dcn_stage(mask1, dest_g, payload_cols, ndcn: int, cap2: int,
+              dcn_axis: str, sortless: bool):
+    """Stage 2 of the hierarchical exchange — ONE implementation shared
+    by the plain two-stage shuffle and the fused combine+shuffle reduce:
+    received rows carry their destination group in ``dest_g``; bucket by
+    it and exchange along the slow DCN axis. Each (source-group,
+    dest-group) pair per lane moves as ONE aggregated message. Returns
+    (mask2, local_overflow, out_cols)."""
+    import jax.numpy as jnp
+
+    g2 = jnp.where(mask1, dest_g, np.int32(ndcn))
+    d2, cols2, off2, counts2 = route_to_buckets(
+        g2, tuple(payload_cols), ndcn, sortless,
+    )
+    in2 = (d2 < ndcn) & (off2 < cap2)
+    row2 = jnp.where(in2, d2, ndcn)
+    o2 = jnp.where(in2, off2, 0)
+    send2 = jnp.minimum(counts2, cap2).astype(np.int32)
+    mask2, out_cols = bucket_exchange(
+        dcn_axis, ndcn, cap2, row2, o2, send2, cols2,
+    )
+    ov2 = jnp.maximum(counts2.max() - cap2, 0)
+    return mask2, ov2, out_cols
 
 
 def make_hier_shuffle_fn(ndcn: int, nici: int, nkeys: int,
@@ -125,18 +152,10 @@ def make_hier_shuffle_fn(ndcn: int, nici: int, nkeys: int,
         # (src group, dst group) pair moves as one message PER ICI
         # LANE — I messages per pod pair, down from the flat
         # exchange's I².
-        g2 = jnp.where(mask1, recv_cols[0], np.int32(ndcn))
-        d2, cols2, off2, counts2 = route_to_buckets(
-            g2, tuple(recv_cols[1:]), ndcn, sortless2,
+        mask2, ov2, out_cols = dcn_stage(
+            mask1, recv_cols[0], recv_cols[1:], ndcn, cap2, dcn_axis,
+            sortless2,
         )
-        in2 = (d2 < ndcn) & (off2 < cap2)
-        row2 = jnp.where(in2, d2, ndcn)
-        o2 = jnp.where(in2, off2, 0)
-        send2 = jnp.minimum(counts2, cap2).astype(np.int32)
-        mask2, out_cols = bucket_exchange(
-            dcn_axis, ndcn, cap2, row2, o2, send2, cols2,
-        )
-        ov2 = jnp.maximum(counts2.max() - cap2, 0)
 
         # Global signals: any stage's bucket overflow anywhere, plus
         # out-of-range partitioner ids (caller raises — user error).
@@ -161,24 +180,34 @@ def make_hier_shuffle_fn(ndcn: int, nici: int, nkeys: int,
 
 class HierMeshReduceByKey:
     """Keyed reduction over a 2-D ("dcn", "ici") mesh: map-side
-    segmented combine → two-stage hierarchical shuffle → reduce-side
-    combine, one jitted SPMD program — the multi-pod counterpart of
-    shuffle.MeshReduceByKey, composed from the same masked kernels
-    (the combine stages are segment.make_segmented_reduce_masked, the
-    exchange is make_hier_shuffle_fn.masked), so its results are the
-    per-shard row sets the flat reduce produces.
+    combine → two-stage hierarchical shuffle → reduce-side combine,
+    one jitted SPMD program — the multi-pod counterpart of
+    shuffle.MeshReduceByKey, so its results are the per-shard row sets
+    the flat reduce produces.
 
-    Known follow-up: the map-side combine is UNFUSED — on sort-routing
-    backends (the TPU default) it pays its own (validity, keys) sort
-    before stage 1's destination sort, where the flat path's
-    make_combine_shuffle_fn serves both with one sort by (validity,
-    destination, keys); the same fusion is valid here (equal keys
-    share dest_i) and is the next step if hier reduces become hot."""
+    ``fused`` (default: on for sort-routing backends, i.e. real TPU)
+    folds the map-side segmented combine into stage 1's routing sort by
+    reusing THE flat fused kernel (shuffle.make_combine_shuffle_fn) in
+    waved mode over the ICI axis: global shard ``s = g*I + i`` is
+    device ``s % I`` of the ICI group with subid ``s // I`` — which IS
+    the destination group — so the kernel's one (validity, lane, subid,
+    keys) sort segments the combine AND orders the ICI routing, and its
+    leading subid output column is exactly the dest-group payload stage
+    2 buckets on (dcn_stage). This drops the separate (validity, keys)
+    combine sort the unfused path pays before the routing sort — the
+    follow-up flagged when hier reduces landed. On sortless-routing
+    backends (CPU meshes) the unfused path's routing is already a
+    linear pass, so the default keeps it; parity between both paths is
+    pinned by test_hier.
+
+    ``donate=True`` donates the staged input buffers to the program
+    (jitutil.jit_maybe_donate): wave-streamed callers that re-stage
+    fresh columns per call reuse HBM instead of reallocating."""
 
     def __init__(self, mesh, nkeys: int, nvals: int, capacity: int,
                  combine_fn: Callable, seed: int = 0,
-                 slack: float = 2.0):
-        import jax
+                 slack: float = 2.0, fused: Optional[bool] = None,
+                 donate: bool = False):
         from jax.sharding import PartitionSpec as P
 
         from bigslice_tpu.parallel import segment
@@ -190,42 +219,82 @@ class HierMeshReduceByKey:
         self.nshards = ndcn * nici
         self.capacity = capacity
         self.out_capacity = ndcn * send_capacity(capacity, ndcn, slack)
+        if fused is None:
+            fused = not sortless_routing_default()
+        self.fused = bool(fused)
         ncols = nkeys + nvals
         cfn = segment.canonical_combine(combine_fn, nvals)
-        combine_local = segment.make_segmented_reduce_masked(
-            nkeys, nvals, cfn, compact=False
-        )
         combine_final = segment.make_segmented_reduce_masked(
             nkeys, nvals, cfn, compact=True
         )
-        body = make_hier_shuffle_fn(
-            ndcn, nici, nkeys, capacity, dcn_axis, ici_axis, seed,
-            slack=slack,
-        )
-
-        def stepped(counts, *cols):
-            import jax.numpy as jnp
-
-            n = counts[0]
-            size = cols[0].shape[0]
-            mask0 = jnp.arange(size, dtype=np.int32) < n
-            keep, k1, v1 = combine_local(mask0, cols[:nkeys],
-                                         cols[nkeys:])
-            mask2, overflow, _bad, out_cols = body.masked(
-                keep, *(tuple(k1) + tuple(v1))
+        if self.fused:
+            # Stage 1 = the flat fused combine+shuffle in waved mode
+            # over ICI (nparts = the global shard count): one sort
+            # serves segmentation and lane routing; out_cols[0] is the
+            # subid = destination group.
+            cap2 = send_capacity(capacity, ndcn, slack)
+            sortless2 = (sortless_routing_default()
+                         and ndcn <= SORTLESS_MAX_LANES)
+            fused1 = make_combine_shuffle_fn(
+                nici, nkeys, nvals, cfn, ici_axis, seed, slack=slack,
+                nparts=self.nshards,
             )
-            n3, k3, v3 = combine_final(
-                mask2, tuple(out_cols[:nkeys]), tuple(out_cols[nkeys:])
+
+            def stepped(counts, *cols):
+                import jax.numpy as jnp
+                from jax import lax
+
+                n = counts[0]
+                size = cols[0].shape[0]
+                mask0 = jnp.arange(size, dtype=np.int32) < n
+                mask1, ov1, _bad, s1_cols = fused1.masked(mask0, *cols)
+                mask2, ov2, out_cols = dcn_stage(
+                    mask1, s1_cols[0], s1_cols[1:], ndcn, cap2,
+                    dcn_axis, sortless2,
+                )
+                overflow = (
+                    lax.psum(ov1, dcn_axis)  # ov1 already psummed (ici)
+                    + lax.psum(lax.psum(ov2, ici_axis), dcn_axis)
+                )
+                n3, k3, v3 = combine_final(
+                    mask2, tuple(out_cols[:nkeys]),
+                    tuple(out_cols[nkeys:]),
+                )
+                return (n3.reshape(1), overflow, tuple(k3) + tuple(v3))
+        else:
+            combine_local = segment.make_segmented_reduce_masked(
+                nkeys, nvals, cfn, compact=False
             )
-            return (n3.reshape(1), overflow, tuple(k3) + tuple(v3))
+            body = make_hier_shuffle_fn(
+                ndcn, nici, nkeys, capacity, dcn_axis, ici_axis, seed,
+                slack=slack,
+            )
+
+            def stepped(counts, *cols):
+                import jax.numpy as jnp
+
+                n = counts[0]
+                size = cols[0].shape[0]
+                mask0 = jnp.arange(size, dtype=np.int32) < n
+                keep, k1, v1 = combine_local(mask0, cols[:nkeys],
+                                             cols[nkeys:])
+                mask2, overflow, _bad, out_cols = body.masked(
+                    keep, *(tuple(k1) + tuple(v1))
+                )
+                n3, k3, v3 = combine_final(
+                    mask2, tuple(out_cols[:nkeys]),
+                    tuple(out_cols[nkeys:])
+                )
+                return (n3.reshape(1), overflow, tuple(k3) + tuple(v3))
 
         col_spec = P((dcn_axis, ici_axis))
         in_specs = (col_spec,) + tuple(col_spec for _ in range(ncols))
         out_specs = (col_spec, P(),
                      tuple(col_spec for _ in range(ncols)))
-        self._jitted = jax.jit(
+        self._jitted = jit_maybe_donate(
             shard_map(stepped, mesh=mesh, in_specs=in_specs,
-                      out_specs=out_specs, check_rep=False)
+                      out_specs=out_specs, check_rep=False),
+            tuple(range(1 + ncols)) if donate else (),
         )
 
     def __call__(self, key_cols: Sequence, val_cols: Sequence, counts):

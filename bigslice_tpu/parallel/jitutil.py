@@ -10,9 +10,79 @@ compiled programs regardless of data skew.
 from __future__ import annotations
 
 import functools
-from typing import Callable, Sequence, Tuple
+from typing import Callable, Optional, Sequence, Tuple
 
 import numpy as np
+
+# Probed once per process (see donation_supported): whether the active
+# backend honors jit buffer donation by actually releasing the donated
+# input. None = not yet probed.
+_DONATION_OK: Optional[bool] = None
+# One-time install of the donation-downgrade warning filter (see
+# jit_maybe_donate).
+_DONATION_FILTER_INSTALLED = False
+
+
+def donation_supported() -> bool:
+    """Does the active JAX backend implement input-buffer donation?
+
+    Donation (``jax.jit(..., donate_argnums=...)``) lets XLA alias a
+    dead input's buffer for an output instead of allocating fresh HBM —
+    the steady-state wave-streaming allocator contract. Backends that
+    don't implement aliasing silently ignore the annotation (correct
+    but useless), so callers gate donated program VARIANTS on this
+    probe rather than compiling them for nothing. The probe donates one
+    tiny buffer and checks it was actually released."""
+    global _DONATION_OK
+    if _DONATION_OK is None:
+        import warnings
+
+        try:
+            import jax
+            import jax.numpy as jnp
+
+            x = jnp.zeros(8, np.int32)
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                jax.jit(
+                    lambda v: v + np.int32(1), donate_argnums=(0,)
+                )(x).block_until_ready()
+            _DONATION_OK = bool(getattr(x, "is_deleted",
+                                        lambda: False)())
+        except Exception:  # no backend / ancient jax: stay undonated
+            _DONATION_OK = False
+    return _DONATION_OK
+
+
+def jit_maybe_donate(fn: Callable, donate_argnums: Sequence[int] = ()):
+    """``jax.jit`` with donation applied only when requested AND the
+    backend honors it — THE one place donated program variants are
+    built, so every caller (the mesh executor's SPMD programs, the
+    standalone shuffle/hashagg/hier kernels, PaddedVmap) shares one
+    gate and one warning policy. Donated and undonated variants are
+    distinct compilations; callers key their caches on the donation
+    signature (a bool / tuple of bools), which bounds the blowup at
+    2× per cache, not one entry per call site."""
+    import jax
+
+    nums = tuple(donate_argnums)
+    if nums and donation_supported():
+        global _DONATION_FILTER_INSTALLED
+        if not _DONATION_FILTER_INSTALLED:
+            import warnings
+
+            # An output that can't alias its donated input (shape or
+            # layout mismatch) downgrades to a copy — correct, just not
+            # free; the per-execution warning would otherwise spam
+            # every wave. Installed ONCE: repeated filterwarnings calls
+            # would grow the process-global filter list on every
+            # donated compile.
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable"
+            )
+            _DONATION_FILTER_INSTALLED = True
+        return jax.jit(fn, donate_argnums=nums)
+    return jax.jit(fn)
 
 
 def bucket_size(n: int, minimum: int = 8) -> int:
@@ -57,27 +127,41 @@ class PaddedVmap:
 
     def __init__(self, fn: Callable):
         self.fn = fn
-        self._jitted = {}  # (ncols, nextra) -> jitted vmapped fn
+        # (ncols, nextra, donate) -> jitted vmapped fn. The donate bit
+        # keys the cache so donated and undonated callers of the SAME
+        # shared instance (get_padded_vmap) coexist at a bounded 2×,
+        # instead of thrashing one entry back and forth.
+        self._jitted = {}
 
-    def _get(self, ncols: int, nextra: int):
-        key = (ncols, nextra)
+    def _get(self, ncols: int, nextra: int, donate: bool = False):
+        key = (ncols, nextra, donate)
         j = self._jitted.get(key)
         if j is None:
             import jax
 
-            j = jax.jit(jax.vmap(
+            vf = jax.vmap(
                 self.fn, in_axes=(0,) * ncols + (None,) * nextra
-            ))
+            )
+            j = jit_maybe_donate(
+                vf, tuple(range(ncols)) if donate else ()
+            )
             self._jitted[key] = j
         return j
 
     def __call__(self, cols: Sequence, n: int,
-                 extra: Sequence = ()) -> Tuple[list, int]:
+                 extra: Sequence = (),
+                 donate: bool = False) -> Tuple[list, int]:
         """Apply to n valid rows of equal-length columns; returns (out
-        columns sliced to n, n)."""
+        columns sliced to n, n).
+
+        ``donate=True`` donates the padded column buffers to the
+        program (HBM reuse for steady-state batch loops); callers must
+        hand in columns they own exclusively — device arrays they will
+        never read again. Host (numpy) columns are always safe: the
+        transfer copy is the program's to donate."""
         target = bucket_size(n)
         padded = pad_cols(cols, n, target)
-        out = self._get(len(cols), len(extra))(*padded, *extra)
+        out = self._get(len(cols), len(extra), donate)(*padded, *extra)
         if not isinstance(out, (tuple, list)):
             out = (out,)
         # Slice on the host: an eager device slice would compile one XLA

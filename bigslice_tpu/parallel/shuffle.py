@@ -26,6 +26,7 @@ from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from bigslice_tpu.parallel.jitutil import jit_maybe_donate
 from bigslice_tpu.parallel.meshutil import get_shard_map, mesh_axis
 
 
@@ -499,11 +500,17 @@ class MeshShuffle:
     of shape [nshards * capacity, ...] sharded on axis 0, and ``counts``
     is an int32[nshards] of valid rows per shard. Returns
     (out_cols, out_counts, overflow_total).
+
+    ``donate=True`` donates the input buffers to the compiled program
+    (jitutil.jit_maybe_donate): callers streaming fresh batches through
+    the same kernel — the wave-pipeline steady state — reuse HBM
+    instead of reallocating it, at the price that inputs are dead after
+    the call.
     """
 
     def __init__(self, mesh, ncols: int, nkeys: int, capacity: int,
-                 seed: int = 0, partition_fn=None, slack: float = 2.0):
-        import jax
+                 seed: int = 0, partition_fn=None, slack: float = 2.0,
+                 donate: bool = False):
         from jax.sharding import PartitionSpec as P
 
         shard_map = get_shard_map()
@@ -527,9 +534,10 @@ class MeshShuffle:
             out_count, overflow, out_cols = body(n, *cols)
             return (out_count.reshape(1), overflow, tuple(out_cols))
 
-        self._jitted = jax.jit(
+        self._jitted = jit_maybe_donate(
             shard_map(stepped, mesh=mesh, in_specs=in_specs,
-                      out_specs=out_specs, check_rep=False)
+                      out_specs=out_specs, check_rep=False),
+            tuple(range(1 + ncols)) if donate else (),
         )
 
     def __call__(self, cols: Sequence, counts):
@@ -548,8 +556,8 @@ class MeshReduceByKey:
     """
 
     def __init__(self, mesh, nkeys: int, nvals: int, capacity: int,
-                 combine_fn: Callable, seed: int = 0, slack: float = 2.0):
-        import jax
+                 combine_fn: Callable, seed: int = 0,
+                 slack: float = 2.0, donate: bool = False):
         from jax.sharding import PartitionSpec as P
 
         from bigslice_tpu.parallel import segment
@@ -595,9 +603,11 @@ class MeshReduceByKey:
         col_spec = P(axis)
         in_specs = (P(axis),) + tuple(col_spec for _ in range(ncols))
         out_specs = (P(axis), P(), tuple(col_spec for _ in range(ncols)))
-        self._jitted = jax.jit(
+        # donate: same steady-state HBM-reuse contract as MeshShuffle.
+        self._jitted = jit_maybe_donate(
             shard_map(stepped, mesh=mesh, in_specs=in_specs,
-                      out_specs=out_specs, check_rep=False)
+                      out_specs=out_specs, check_rep=False),
+            tuple(range(1 + ncols)) if donate else (),
         )
 
     def __call__(self, key_cols: Sequence, val_cols: Sequence, counts):

@@ -141,11 +141,23 @@ class StatusPrinter:
 
 
 def chain_monitors(*monitors):
-    """Compose monitors (evaluator accepts a single callable)."""
+    """Compose monitors (evaluator accepts a single callable).
+
+    Members exposing ``on_phase`` (the wave-pipeline phase channel,
+    exec/evaluate.notify_phase) get a composed forwarder on the chained
+    monitor; state-only members are untouched by phase events."""
     mons = [m for m in monitors if m is not None]
 
     def monitor(task, state):
         for m in mons:
             m(task, state)
 
+    phase_mons = [m for m in mons
+                  if getattr(m, "on_phase", None) is not None]
+    if phase_mons:
+        def on_phase(task, phase, wave):
+            for m in phase_mons:
+                m.on_phase(task, phase, wave)
+
+        monitor.on_phase = on_phase
     return monitor

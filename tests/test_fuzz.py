@@ -3,7 +3,14 @@ round-trips, sliceio/codec_test.go, and testing/quick oracle checks,
 example/max_test.go:49-60)."""
 
 import numpy as np
-from hypothesis import given, settings, strategies as st
+import pytest
+
+# Optional dev dependency (pyproject [project.optional-dependencies]
+# dev): without it this module must SKIP, not kill collection of the
+# whole tier-1 suite.
+pytest.importorskip("hypothesis")
+
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 import bigslice_tpu as bs
 from bigslice_tpu import slicetest

@@ -134,3 +134,90 @@ def test_hier_reduce_matches_oracle_and_flat(meshes):
             assert k not in got
             got[k] = v
     assert got == oracle
+
+
+def test_hier_reduce_fused_matches_unfused_and_oracle(meshes):
+    """The fused hier reduce (map-side combine folded into stage 1's
+    routing sort by reusing the flat make_combine_shuffle_fn in waved
+    mode) produces the same per-shard row sets as the unfused path,
+    the flat reduce, and the Python oracle — pinned explicitly since
+    the CPU-mesh default is unfused (sortless routing)."""
+    flat, grid = meshes
+    rng = np.random.RandomState(21)
+    cap = 512
+    per = 140
+    n = 8
+    kc = [rng.randint(0, 37, per).astype(np.int32) for _ in range(n)]
+    vc = [rng.randint(0, 9, per).astype(np.int32) for _ in range(n)]
+
+    def add(a, b):
+        return a + b
+
+    def run(fused):
+        cols_g, counts_g = shuffle_mod.shard_columns(
+            grid, [kc, vc], [per] * n, cap
+        )
+        red = hier.HierMeshReduceByKey(
+            grid, nkeys=1, nvals=1, capacity=cap, combine_fn=add,
+            fused=fused,
+        )
+        assert red.fused == fused
+        kg, vg, cnt, ov = red([cols_g[0]], [cols_g[1]], counts_g)
+        assert int(ov) == 0
+        return _shard_rows(kg + vg, cnt, red.out_capacity, n)
+
+    fused_rows = run(True)
+    unfused_rows = run(False)
+    assert fused_rows == unfused_rows
+
+    cols_f, counts_f = shuffle_mod.shard_columns(
+        flat, [kc, vc], [per] * n, cap
+    )
+    red_f = shuffle_mod.MeshReduceByKey(flat, nkeys=1, nvals=1,
+                                        capacity=cap, combine_fn=add)
+    kf, vf, cnt_f, ov_f = red_f([cols_f[0]], [cols_f[1]], counts_f)
+    assert int(ov_f) == 0
+    assert fused_rows == _shard_rows(kf + vf, cnt_f,
+                                     red_f.out_capacity, n)
+
+    oracle = {}
+    for k, v in zip(np.concatenate(kc).tolist(),
+                    np.concatenate(vc).tolist()):
+        oracle[k] = oracle.get(k, 0) + v
+    got = dict(kv for shard in fused_rows for kv in shard)
+    assert got == oracle
+
+
+def test_hier_reduce_fused_donate_consumes_inputs(meshes):
+    """donate=True on the hier reduce consumes staged inputs when the
+    backend aliases them — wave-streaming HBM reuse at kernel level."""
+    from bigslice_tpu.parallel.jitutil import donation_supported
+
+    if not donation_supported():
+        import pytest
+
+        pytest.skip("backend does not implement buffer donation")
+    _flat, grid = meshes
+    rng = np.random.RandomState(4)
+    cap = 256
+    per = 100
+    n = 8
+    kc = [rng.randint(0, 19, per).astype(np.int32) for _ in range(n)]
+    vc = [np.ones(per, np.int32) for _ in range(n)]
+    cols_g, counts_g = shuffle_mod.shard_columns(
+        grid, [kc, vc], [per] * n, cap
+    )
+    red = hier.HierMeshReduceByKey(
+        grid, nkeys=1, nvals=1, capacity=cap,
+        combine_fn=lambda a, b: a + b, fused=True, donate=True,
+    )
+    kg, vg, cnt, ov = red([cols_g[0]], [cols_g[1]], counts_g)
+    assert int(ov) == 0
+    oracle = {}
+    for k in np.concatenate(kc).tolist():
+        oracle[k] = oracle.get(k, 0) + 1
+    got = dict(
+        kv for shard in _shard_rows(kg + vg, cnt, red.out_capacity, n)
+        for kv in shard
+    )
+    assert got == oracle

@@ -121,3 +121,110 @@ def test_atomic_write_cleanup_on_error():
                 fp.write(b"partial")
                 raise RuntimeError("boom")
         assert not fileio.exists(path)
+
+
+def test_filestore_prefetch_warms_and_serves(prefix):
+    """Store.prefetch read-ahead (the wave prefetcher's hint): the
+    warmed partition serves the next read without re-opening the file,
+    once; later reads stream from the file again."""
+    import time
+
+    store = FileStore(prefix)
+    name = TaskName(1, "warm", 0, 1)
+    store.put(name, 0, [_frame([4, 5, 6])])
+    store.prefetch(name, 0)
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        with store._warm_lock:
+            if (name, 0) in store._warm:
+                break
+        time.sleep(0.01)
+    with store._warm_lock:
+        assert (name, 0) in store._warm
+    frames = list(store.read(name, 0))
+    assert [f.cols[0].tolist() for f in frames] == [[4, 5, 6]]
+    with store._warm_lock:  # one-shot: consumed by the read
+        assert (name, 0) not in store._warm
+    # The file stays authoritative for re-reads.
+    frames = list(store.read(name, 0))
+    assert [f.cols[0].tolist() for f in frames] == [[4, 5, 6]]
+
+
+def test_filestore_prefetch_missing_is_silent(prefix):
+    """A prefetch of an uncommitted partition must not poison reads:
+    the later read raises the authoritative Missing."""
+    import time
+
+    store = FileStore(prefix)
+    name = TaskName(1, "nothere", 0, 1)
+    store.prefetch(name, 0)
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        with store._warm_lock:
+            if (name, 0) not in store._warm_pending:
+                break
+        time.sleep(0.01)
+    with pytest.raises(Missing):
+        store.read(name, 0)
+
+
+def test_filestore_prefetch_discard_drops_warm(prefix):
+    """discard() must drop warmed frames — a recomputed task's fresh
+    output must never lose to a stale warm entry."""
+    import time
+
+    store = FileStore(prefix)
+    name = TaskName(1, "stale", 0, 1)
+    store.put(name, 0, [_frame([1])])
+    store.prefetch(name, 0)
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        with store._warm_lock:
+            if (name, 0) in store._warm:
+                break
+        time.sleep(0.01)
+    store.discard(name)
+    with pytest.raises(Missing):
+        store.read(name, 0)
+
+
+def test_filestore_prefetch_race_with_discard_not_stale(prefix):
+    """A prefetch in flight when discard() lands must NOT repopulate
+    the warm cache with pre-discard frames (generation guard): the
+    recomputed task's output, not the stale one, is authoritative."""
+    import threading
+    import time
+
+    store = FileStore(prefix)
+    name = TaskName(1, "race", 0, 1)
+    store.put(name, 0, [_frame([1])])
+    gate = threading.Event()
+    orig = store._read_direct
+
+    def slow_read(n, p):
+        frames = list(orig(n, p))
+        gate.wait(5)  # hold the read open across the discard
+        return iter(frames)
+
+    store._read_direct = slow_read
+    store.prefetch(name, 0)
+    deadline = time.time() + 5.0
+    while time.time() < deadline:  # wait for the worker to be reading
+        with store._warm_lock:
+            if (name, 0) in store._warm_pending and gate is not None:
+                break
+        time.sleep(0.01)
+    time.sleep(0.05)
+    store.discard(name)  # races the in-flight prefetch
+    gate.set()
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        with store._warm_lock:
+            if (name, 0) not in store._warm_pending:
+                break
+        time.sleep(0.01)
+    with store._warm_lock:  # stale frames must not have been cached
+        assert (name, 0) not in store._warm
+    store._read_direct = orig
+    with pytest.raises(Missing):
+        store.read(name, 0)

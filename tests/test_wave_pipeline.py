@@ -1,0 +1,387 @@
+"""The overlapped wave pipeline: prefetch staging, non-blocking
+dispatch, and buffer donation in the mesh executor (S > N wave
+streaming).
+
+Pins the two contracts the pipeline must keep:
+
+- PARITY: prefetch_depth=0 (the strictly serial loop) and
+  prefetch_depth>=1 (staging overlap + in-flight dispatch window)
+  produce identical merged outputs — the pipeline reorders nothing
+  observable, it only hides host staging behind device compute.
+- DONATION SAFETY: per-wave buffers the executor staged itself are
+  donated (and so deleted) after their wave, yet merged/streamed
+  outputs never observe the reuse — zero-copy producer outputs are
+  never donated, and wave outputs are donated only into the cross-wave
+  merge that consumes them.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+import bigslice_tpu as bs
+from bigslice_tpu.exec.evaluate import (
+    PHASE_WAVE_COMPUTE,
+    PHASE_WAVE_PREFETCH,
+)
+from bigslice_tpu.exec.meshexec import MeshExecutor
+from bigslice_tpu.exec.session import Session
+
+
+@pytest.fixture
+def mesh():
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(jax.devices()[:8]), ("shards",))
+
+
+def _sess(mesh, depth, **kw):
+    return Session(executor=MeshExecutor(mesh, prefetch_depth=depth,
+                                         **kw))
+
+
+def _waved_reduce_rows(mesh, depth, **kw):
+    """S=32 shards on the 8-device mesh (4×N): keyed Reduce through the
+    wave-partitioned shuffle + cross-wave merge."""
+    rng = np.random.RandomState(23)
+    keys = rng.randint(0, 97, 32 * 64).astype(np.int32)
+    vals = rng.randint(1, 9, 32 * 64).astype(np.int32)
+    sess = _sess(mesh, depth, **kw)
+    res = sess.run(bs.Reduce(bs.Const(32, keys, vals),
+                             lambda a, b: a + b))
+    rows = sorted(res.rows())
+    assert sess.executor.device_group_count() >= 2
+    oracle = {}
+    for k, v in zip(keys.tolist(), vals.tolist()):
+        oracle[k] = oracle.get(k, 0) + v
+    assert dict(rows) == oracle
+    return rows
+
+
+def test_prefetch_parity_waved_reduce(mesh):
+    """The acceptance contract: prefetch 0 and 1 (and 2) yield
+    identical merged outputs on an S=4×N wave-streamed keyed Reduce."""
+    serial = _waved_reduce_rows(mesh, depth=0)
+    piped = _waved_reduce_rows(mesh, depth=1)
+    deep = _waved_reduce_rows(mesh, depth=2)
+    assert serial == piped == deep
+
+
+def test_prefetch_parity_waved_cogroup(mesh):
+    """S=4×N ragged Cogroup (unpartitioned waved output, per-wave shard
+    identity): serial and pipelined runs agree group for group."""
+    rng = np.random.RandomState(5)
+    keys = rng.randint(0, 41, 32 * 40).astype(np.int32)
+    vals = rng.randint(0, 1000, 32 * 40).astype(np.int32)
+
+    def run(depth):
+        sess = _sess(mesh, depth)
+        res = sess.run(bs.Cogroup(bs.Const(32, keys, vals)))
+        out = sorted(
+            (k, sorted(g)) for k, g in res.rows()
+        )
+        assert sess.executor.device_group_count() >= 1
+        return out
+
+    serial = run(0)
+    piped = run(1)
+    assert serial == piped
+    oracle = {}
+    for k, v in zip(keys.tolist(), vals.tolist()):
+        oracle.setdefault(k, []).append(v)
+    assert serial == sorted((k, sorted(g)) for k, g in oracle.items())
+
+
+def test_prefetch_parity_float_reduce(mesh):
+    """Float combine (min) across waves: the pipelined schedule must
+    not change floating-point results — same programs, same inputs,
+    same dispatch order, bit-equal outputs."""
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(11)
+    keys = rng.randint(0, 60, 32 * 50).astype(np.int32)
+    vals = rng.rand(32 * 50).astype(np.float32)
+
+    def run(depth):
+        sess = _sess(mesh, depth)
+        res = sess.run(bs.Reduce(bs.Const(32, keys, vals),
+                                 lambda a, b: jnp.minimum(a, b)))
+        return sorted(res.rows())
+
+    r0, r1 = run(0), run(1)
+    assert [k for k, _ in r0] == [k for k, _ in r1]
+    np.testing.assert_array_equal(
+        np.array([v for _, v in r0]), np.array([v for _, v in r1])
+    )
+
+
+def test_donated_wave_buffers_consumed_not_aliased(mesh):
+    """Donation engages on staged wave uploads (XLA deletes the donated
+    buffers whose shapes alias an output — the steady-state case, where
+    input and receive capacities match) AND the merged output never
+    observes the reuse: results still match the oracle after donated
+    HBM has been recycled. auto_dense pinned off so the generic wave
+    program (whose receive buffer matches the input capacity at slack
+    1.0) runs — donation at the XLA level is input→output ALIASING, so
+    a shape-changing lowering legitimately declines it."""
+    from bigslice_tpu.parallel.jitutil import donation_supported
+
+    if not donation_supported():
+        pytest.skip("backend does not implement buffer donation")
+    ex = MeshExecutor(mesh, prefetch_depth=1, donate_buffers=True,
+                      auto_dense=False)
+    staged = []
+    orig = ex._upload
+
+    def spy_upload(frames):
+        out = orig(frames)
+        staged.append(out)
+        return out
+
+    ex._upload = spy_upload
+    sess = Session(executor=ex)
+    rng = np.random.RandomState(7)
+    keys = rng.randint(0, 24, 32 * 200).astype(np.int32)
+    vals = rng.randint(1, 7, 32 * 200).astype(np.int32)
+    res = sess.run(bs.Reduce(bs.Const(32, keys, vals),
+                             lambda a, b: a + b))
+    oracle = {}
+    for k, v in zip(keys.tolist(), vals.tolist()):
+        oracle[k] = oracle.get(k, 0) + v
+    # Correctness first: a donated buffer aliased into a live output
+    # would corrupt these sums.
+    assert dict(res.rows()) == oracle
+    assert staged, "waved source never staged uploads"
+    deleted = [
+        all(c.is_deleted() for c in cols)
+        for cols, _counts, _cap, _sub, owned in staged if owned
+    ]
+    # Donation actually engaged: staged wave inputs were consumed.
+    assert any(deleted), (
+        "no staged upload was ever consumed by its wave program"
+    )
+    # And reading the result AGAIN (store-bridge re-materialization)
+    # still works — merged outputs hold their own buffers.
+    assert dict(res.rows()) == oracle
+
+
+def test_donation_off_knob(mesh):
+    """donate_buffers=False keeps every staged buffer alive (the
+    debugging/off switch documented in docs/wave_pipeline.md)."""
+    ex = MeshExecutor(mesh, prefetch_depth=1, donate_buffers=False)
+    staged = []
+    orig = ex._upload
+
+    def spy_upload(frames):
+        out = orig(frames)
+        staged.append(out)
+        return out
+
+    ex._upload = spy_upload
+    sess = Session(executor=ex)
+    keys = np.arange(32 * 16, dtype=np.int32) % 19
+    vals = np.ones(32 * 16, np.int32)
+    res = sess.run(bs.Reduce(bs.Const(32, keys, vals),
+                             lambda a, b: a + b))
+    assert len(dict(res.rows())) == 19
+    assert staged
+    assert not any(
+        c.is_deleted() for cols, *_ in staged for c in cols
+    )
+
+
+def test_wave_phase_events(mesh):
+    """Monitors opting in via ``on_phase`` see the pipeline's
+    prefetch/compute markers in wave order (evaluate.notify_phase →
+    status.chain_monitors forwarding)."""
+    events = []
+
+    class PhaseMonitor:
+        def __call__(self, task, state):
+            pass
+
+        def on_phase(self, task, phase, wave):
+            events.append((phase, wave))
+
+    ex = MeshExecutor(mesh, prefetch_depth=1)
+    sess = Session(executor=ex, monitor=PhaseMonitor())
+    keys = (np.arange(32 * 16, dtype=np.int32) * 7) % 23
+    res = sess.run(bs.Reduce(bs.Const(32, keys,
+                                      np.ones(32 * 16, np.int32)),
+                             lambda a, b: a + b))
+    assert len(dict(res.rows())) == 23
+    computes = [w for p, w in events if p == PHASE_WAVE_COMPUTE]
+    prefetches = [w for p, w in events if p == PHASE_WAVE_PREFETCH]
+    # Every wave of the 32-shard groups dispatched in order, and the
+    # prefetcher staged every wave past the first.
+    assert computes, events
+    assert sorted(set(computes)) == list(range(max(computes) + 1))
+    assert prefetches and 0 not in prefetches
+
+
+def test_budget_clamps_prefetch_depth(mesh):
+    """prefetch never busts device_budget_bytes: when one wave's
+    estimated working set already fills the budget, the effective
+    depth collapses to 0 (serial), and results stay correct."""
+    ex = MeshExecutor(mesh, prefetch_depth=2,
+                      device_budget_bytes=2_000)
+    sess = Session(executor=ex)
+    rng = np.random.RandomState(3)
+    keys = rng.randint(0, 29, 32 * 64).astype(np.int32)
+    vals = np.ones(32 * 64, np.int32)
+    res = sess.run(bs.Reduce(bs.Const(32, keys, vals),
+                             lambda a, b: a + b))
+    oracle = {}
+    for k in keys.tolist():
+        oracle[k] = oracle.get(k, 0) + 1
+    assert dict(res.rows()) == oracle
+    # The knob itself stays as configured; only the per-group effective
+    # depth clamps.
+    assert ex.prefetch_depth == 2
+    fake_inputs = [([np.zeros(512, np.int32)], np.zeros(8, np.int32),
+                    512, False, True)]
+    t0 = _first_waved_task(sess)
+    assert ex._effective_prefetch_depth(t0, fake_inputs, 4) == 0
+
+
+def _first_waved_task(sess):
+    """Any waved task recorded by the executor (for unit-poking the
+    depth calculation)."""
+    ex = sess.executor
+    with ex._lock:
+        for _name, (_key, t) in ex._task_index.items():
+            return t
+    raise AssertionError("no device task recorded")
+
+
+def test_prefetch_depth_env_default(mesh, monkeypatch):
+    monkeypatch.setenv("BIGSLICE_PREFETCH_DEPTH", "3")
+    ex = MeshExecutor(mesh)
+    assert ex.prefetch_depth == 3
+    monkeypatch.setenv("BIGSLICE_PREFETCH_DEPTH", "0")
+    ex = MeshExecutor(mesh)
+    assert ex.prefetch_depth == 0
+
+
+def test_hash_reduce_kernel_matches_sort_kernel(mesh):
+    """The standalone sortless kernel (hashagg.MeshHashReduceByKey)
+    agrees with the sort-pipeline kernel and the numpy oracle; its
+    donated variant consumes its inputs."""
+    from bigslice_tpu.parallel import hashagg as hashagg_mod
+    from bigslice_tpu.parallel import shuffle as shuffle_mod
+    from bigslice_tpu.parallel.jitutil import donation_supported
+
+    rng = np.random.RandomState(19)
+    n, per = 8, 256
+    cap = per
+    # Key space sized for the hash table's per-region capacity
+    # (combine_region_size(256, 8) = 32 slots vs ~13 distinct keys per
+    # region): a cascade overflow here would be a planner bug, not skew.
+    keys = rng.randint(0, 100, n * per).astype(np.int32)
+    vals = rng.randint(1, 10, n * per).astype(np.int32)
+    kc = [keys[i * per:(i + 1) * per] for i in range(n)]
+    vc = [vals[i * per:(i + 1) * per] for i in range(n)]
+
+    def staged():
+        cols, counts = shuffle_mod.shard_columns(
+            mesh, [kc, vc], [per] * n, cap
+        )
+        return cols, counts
+
+    cols, counts = staged()
+    hashed = hashagg_mod.MeshHashReduceByKey(
+        mesh, nkeys=1, nvals=1, capacity=cap, ops=["add"]
+    )
+    hk, hv, hn, hov = hashed([cols[0]], [cols[1]], counts)
+    assert int(np.asarray(hov)) == 0
+    sorted_red = shuffle_mod.MeshReduceByKey(
+        mesh, nkeys=1, nvals=1, capacity=cap,
+        combine_fn=lambda a, b: a + b,
+    )
+    cols2, counts2 = staged()
+    sk, sv, sn, sov = sorted_red([cols2[0]], [cols2[1]], counts2)
+    assert int(np.asarray(sov)) == 0
+
+    def rowset(k, v, cnt, capacity):
+        chunks = shuffle_mod.unshard_columns([k, v], np.asarray(cnt),
+                                             capacity)
+        return sorted(
+            (int(kk), int(vv))
+            for ks, vs in zip(*chunks)
+            for kk, vv in zip(np.asarray(ks), np.asarray(vs))
+        )
+
+    got_h = rowset(hk[0], hv[0], hn, hashed.out_capacity)
+    got_s = rowset(sk[0], sv[0], sn, sorted_red.out_capacity)
+    oracle = {}
+    for k, v in zip(keys.tolist(), vals.tolist()):
+        oracle[k] = oracle.get(k, 0) + v
+    assert got_h == sorted(oracle.items())
+    assert got_h == got_s
+
+    if donation_supported():
+        cols3, counts3 = staged()
+        donating = hashagg_mod.MeshHashReduceByKey(
+            mesh, nkeys=1, nvals=1, capacity=cap, ops=["add"],
+            donate=True,
+        )
+        dk, dv, dn, dov = donating([cols3[0]], [cols3[1]], counts3)
+        assert int(np.asarray(dov)) == 0
+        assert rowset(dk[0], dv[0], dn,
+                      donating.out_capacity) == sorted(oracle.items())
+        assert cols3[0].is_deleted() and cols3[1].is_deleted()
+
+
+def test_subid_split_parity_and_engagement(mesh):
+    """The one-pass subid pre-split (consumer waves chain on their own
+    compacted partition rows instead of subid-filtering the full
+    receive buffer) changes nothing observable: split on/off produce
+    identical rows, and the split views actually engage (the producer's
+    wave-partitioned output grows per-wave views)."""
+    rng = np.random.RandomState(31)
+    keys = rng.randint(0, 1 << 14, 32 * 80).astype(np.int32)
+    vals = rng.randint(1, 5, 32 * 80).astype(np.int32)
+
+    def run(split):
+        ex = MeshExecutor(mesh, prefetch_depth=1, subid_split=split)
+        sess = Session(executor=ex)
+        res = sess.run(bs.Reduce(bs.Const(32, keys, vals),
+                                 lambda a, b: a + b))
+        rows = sorted(res.rows())
+        views = [
+            getattr(o, "_wave_views", None)
+            for o in ex._outputs.values()
+        ]
+        return rows, any(v is not None for v in views)
+
+    on_rows, on_views = run(True)
+    off_rows, off_views = run(False)
+    assert on_rows == off_rows
+    assert on_views and not off_views
+    oracle = {}
+    for k, v in zip(keys.tolist(), vals.tolist()):
+        oracle[k] = oracle.get(k, 0) + v
+    assert dict(on_rows) == oracle
+
+
+def test_subid_split_declines_under_budget(mesh):
+    """Under a tuned device_budget_bytes the split's W-view residency
+    blowup must decline (consumers keep the subid-filter program) and
+    results stay correct."""
+    ex = MeshExecutor(mesh, prefetch_depth=0, subid_split=True,
+                      device_budget_bytes=1_000)
+    sess = Session(executor=ex)
+    rng = np.random.RandomState(9)
+    keys = rng.randint(0, 300, 32 * 64).astype(np.int32)
+    vals = np.ones(32 * 64, np.int32)
+    res = sess.run(bs.Reduce(bs.Const(32, keys, vals),
+                             lambda a, b: a + b))
+    oracle = {}
+    for k in keys.tolist():
+        oracle[k] = oracle.get(k, 0) + 1
+    assert dict(res.rows()) == oracle
+    for o in ex._outputs.values():
+        views = getattr(o, "_wave_views", None)
+        if views is not None:
+            assert views[1] is None  # declined, decline cached
