@@ -46,12 +46,17 @@ import time
 import numpy as np
 
 
-def emit(metric: str, value: float, unit: str, baseline: float) -> None:
+def emit(metric: str, value: float, unit: str, baseline: float,
+         **extra) -> None:
+    """One bench JSON line; ``extra`` fields (e.g. the telemetry hub's
+    overlap_efficiency) ride along so BENCH_*.json snapshots can carry
+    them next to throughput."""
     print(json.dumps({
         "metric": metric,
         "value": round(value, 3),
         "unit": unit,
         "vs_baseline": round(value / baseline, 3) if baseline else 0.0,
+        **extra,
     }))
 
 
@@ -267,10 +272,18 @@ def reduce_wave_bench(keys, vals, num_shards: int, iters: int = 3,
     if sess.executor.device_group_count() == 0:
         raise RuntimeError("wave reduce never engaged the device path")
     best = min(times)
+    # Wave-overlap accounting (utils/telemetry.py): how much of the
+    # staging time the prefetch pipeline hid behind compute across the
+    # whole session — recorded into BENCH json beside rows/sec so the
+    # perf trajectory carries pipeline efficiency, not just throughput.
+    summary = sess.telemetry_summary()
+    overlap = summary.get("overlap_efficiency")
     note(f"reduce_wave[{'pipelined' if pipelined else 'serial'}]: "
          f"{distinct} distinct keys, {num_shards} shards on "
-         f"{mesh.devices.size} devices, best {best*1e3:.0f} ms")
-    return len(keys) / best
+         f"{mesh.devices.size} devices, best {best*1e3:.0f} ms, "
+         f"overlap efficiency "
+         f"{overlap if overlap is not None else 'n/a'}")
+    return len(keys) / best, overlap
 
 
 # ------------------------------------------------------------------ join
@@ -792,11 +805,15 @@ def run_mode(mode: str, size, fallback: bool) -> None:
         rng = np.random.RandomState(42)
         keys = rng.randint(0, 1 << 20, n_rows).astype(np.int32)
         vals = np.ones(n_rows, dtype=np.int32)
-        serial = reduce_wave_bench(keys, vals, S, pipelined=False)
-        piped = reduce_wave_bench(keys, vals, S, pipelined=True)
+        serial, serial_overlap = reduce_wave_bench(keys, vals, S,
+                                                   pipelined=False)
+        piped, piped_overlap = reduce_wave_bench(keys, vals, S,
+                                                 pipelined=True)
         note(f"reduce_wave: serial {serial:,.0f} rows/s, pipelined "
              f"{piped:,.0f} rows/s → {piped/serial:.2f}x")
-        emit("reduce_wave_e2e_rows_per_sec", piped, "rows/sec", serial)
+        emit("reduce_wave_e2e_rows_per_sec", piped, "rows/sec", serial,
+             overlap_efficiency=piped_overlap,
+             serial_overlap_efficiency=serial_overlap)
     elif mode == "reduce-kernel":
         n_rows = size or (1 << 21 if fallback else 1 << 24)
         rng = np.random.RandomState(42)

@@ -52,10 +52,17 @@ def notify_phase(monitor, task, phase: str, wave: int) -> None:
     utils.status.chain_monitors, which forwards to every opted-in
     member). Monitors that only understand (task, state) transitions
     are untouched — the phase channel is additive, so existing status
-    displays and tracers keep working unmodified."""
+    displays and tracers keep working unmodified.
+
+    Exception-isolated: phase events fire from the wave pipeline's
+    prefetcher thread (exec/meshexec._emit_phase), where a raising
+    monitor would otherwise poison staging for the whole group
+    (utils.status.safe_monitor_call logs once and keeps going)."""
     fn = getattr(monitor, "on_phase", None)
     if fn is not None:
-        fn(task, phase, wave)
+        from bigslice_tpu.utils.status import safe_monitor_call
+
+        safe_monitor_call(fn, task, phase, wave, key=id(monitor))
 
 # Safety-net sweep interval: the event-driven loop needs no polling, but
 # a lost wakeup (executor dropping a task without a transition) must
@@ -90,7 +97,13 @@ class _Evaluation:
 
     def _wake(self, task: Task, state: TaskState) -> None:
         if self.monitor is not None:
-            self.monitor(task, state)
+            # Isolated: _wake runs inside Task.set_state on whatever
+            # thread performed the transition (executor workers, the
+            # dispatcher) — a raising monitor must not turn a healthy
+            # transition into a task failure or a lost wakeup.
+            from bigslice_tpu.utils.status import safe_monitor_call
+
+            safe_monitor_call(self.monitor, task, state)
         with self.cond:
             self.events.append((task, state))
             self.cond.notify_all()

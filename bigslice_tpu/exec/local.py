@@ -278,6 +278,21 @@ class LocalExecutor:
         finally:
             self._limiter.release(permits)
 
+    def _record_shuffle(self, task: Task, rows: List[int],
+                        nbytes: List[int]) -> None:
+        """Report this producer's per-partition routed sizes to the
+        session's telemetry hub (contributions accumulate elementwise
+        per op there). Best-effort: telemetry never fails a task."""
+        hub = getattr(getattr(self, "session", None), "telemetry",
+                      None)
+        if hub is None:
+            return
+        try:
+            hub.record_shuffle(task.name.op, task.name.inv_index,
+                               rows, nbytes)
+        except Exception:
+            pass
+
     def _execute(self, task: Task) -> None:
         spillers: List[Optional[object]] = []
         try:
@@ -302,12 +317,23 @@ class LocalExecutor:
         pending_rows = [0] * nparts
         flush_at = [COMBINE_FLUSH_ROWS] * nparts
         spillers.extend([None] * nparts)
+        # Shuffle-boundary telemetry (utils/telemetry.py): rows/bytes
+        # ROUTED per partition, pre-combine — the honest skew signal
+        # for combiner-bearing shuffles, where post-combine sizes are
+        # ~distinct-keys and hide a hot key entirely.
+        routed_rows = [0] * nparts
+        routed_bytes = [0] * nparts
         for frame in reader:
             if not len(frame):
                 continue
             ids = task.partitioner.partition_ids(frame, nparts)
             for p, sub in enumerate(partition_frame(frame, ids, nparts)):
                 if len(sub):
+                    routed_rows[p] += len(sub)
+                    routed_bytes[p] += sum(
+                        int(getattr(c, "nbytes", 0) or 0)
+                        for c in getattr(sub, "cols", ())
+                    )
                     parts[p].append(sub)
                     pending_rows[p] += len(sub)
                     if (task.combiner is not None
@@ -338,6 +364,8 @@ class LocalExecutor:
                         spillers[p].spill(iter(parts[p]))
                         parts[p] = []
                         pending_rows[p] = 0
+        if nparts > 1:
+            self._record_shuffle(task, routed_rows, routed_bytes)
         comb = task.combiner
         ck = task.partitioner.combine_key
         if comb is not None and ck:

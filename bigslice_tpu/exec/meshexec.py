@@ -33,6 +33,7 @@ depends on the mesh path.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -1419,13 +1420,17 @@ class MeshExecutor:
             ]
             wave_outs = self._execute_waves(task0, wave_tasks)
             if task0.num_partition > 1:
-                self._outputs[key] = self._merge_outputs(wave_outs,
-                                                         task0)
+                merged = self._merge_outputs(wave_outs, task0)
+                self._outputs[key] = merged
+                self._record_shuffle(task0, merged)
             else:
                 self._outputs[key] = WavedGroupOutput(wave_outs,
                                                       self.nmesh)
             return
-        self._outputs[key] = self._execute_wave(tasks, wave=0)
+        out = self._execute_wave(tasks, wave=0)
+        self._outputs[key] = out
+        if task0.num_partition > 1:
+            self._record_shuffle(task0, out)
 
     # -- the overlapped wave pipeline -----------------------------------
 
@@ -1442,6 +1447,68 @@ class MeshExecutor:
 
     def _donation_on(self) -> bool:
         return self.donate_buffers and donation_supported()
+
+    # -- telemetry seams (utils/telemetry.py) ---------------------------
+    #
+    # All best-effort: the hub aggregates skew / straggler / overlap
+    # signals for operators, and a telemetry failure must never fail a
+    # wave. Costs are bounded: staging/compute records are O(1) host
+    # arithmetic; the shuffle-size record syncs nmesh int32 counts from
+    # a program whose signal scalars the caller already synced.
+
+    def _telemetry_hub(self):
+        sess = getattr(self, "session", None)
+        return getattr(sess, "telemetry", None)
+
+    def _telemetry_staging(self, task0: Task, wave: int, dur_s: float,
+                           exposed_s: float) -> None:
+        """One wave's input staging time and the portion of it the
+        compute thread actually waited on (== dur_s on serial paths;
+        the staged.get() wait on the pipelined path)."""
+        hub = self._telemetry_hub()
+        if hub is None:
+            return
+        try:
+            hub.record_wave_staging(task0.name.op,
+                                    task0.name.inv_index,
+                                    wave, dur_s, exposed_s)
+        except Exception:
+            pass
+
+    def _telemetry_compute(self, task0: Task, wave: int,
+                           dur_s: float) -> None:
+        hub = self._telemetry_hub()
+        if hub is None:
+            return
+        try:
+            hub.record_wave_compute(task0.name.op,
+                                    task0.name.inv_index, wave, dur_s)
+        except Exception:
+            pass
+
+    def _record_shuffle(self, task0: Task, out) -> None:
+        """Per-device output sizes of a partitioned (shuffle-boundary)
+        group for the skew detector. Post-combine for fused
+        shuffle+combine programs — the mesh program's only host-visible
+        per-device counts; the local tier reports pre-combine routed
+        rows, so combiner-hidden skew still surfaces on mixed-tier
+        pipelines. Multi-process meshes skip: the counts sync would be
+        a host gather of a globally-sharded array."""
+        hub = self._telemetry_hub()
+        if hub is None or self.multiprocess:
+            return
+        try:
+            counts = np.asarray(out.counts).reshape(-1)
+            rowbytes = sum(
+                np.dtype(c.dtype).itemsize for c in out.cols
+            ) or 4
+            hub.record_shuffle(
+                task0.name.op, task0.name.inv_index,
+                [int(c) for c in counts],
+                [int(c) * rowbytes for c in counts],
+            )
+        except Exception:
+            pass
 
     def _effective_prefetch_depth(self, task0: Task, inputs,
                                   nwaves: int) -> int:
@@ -1466,7 +1533,12 @@ class MeshExecutor:
         """Run a waved group, serially (prefetch_depth 0) or through
         the overlapped pipeline. Wave 0's inputs stage inline either
         way: the budget-aware depth decision needs their size."""
+        t0 = time.perf_counter()
         inputs0 = self._group_inputs(wave_tasks[0], 0)
+        stage0 = time.perf_counter() - t0
+        # Wave 0 staging is exposed by construction (nothing computes
+        # yet for prefetch to hide behind).
+        self._telemetry_staging(task0, 0, stage0, stage0)
         depth = self._effective_prefetch_depth(task0, inputs0,
                                                len(wave_tasks))
         if depth == 0:
@@ -1510,12 +1582,14 @@ class MeshExecutor:
                     # Read-ahead hints stay just ahead of staging (the
                     # store's warm cache is small — hinting every wave
                     # upfront would evict entries before their read).
+                    t0 = time.perf_counter()
                     self._hint_store_prefetch(wave_tasks, w + 1,
                                               w + 1 + depth)
-                    item = (self._group_inputs(wave_tasks[w], w), None)
+                    item = (self._group_inputs(wave_tasks[w], w), None,
+                            time.perf_counter() - t0)
                     self._emit_phase(task0, PHASE_WAVE_PREFETCH, w)
                 except BaseException as e:  # noqa: BLE001 — re-raised
-                    item = (None, e)       # in wave order on the main
+                    item = (None, e, 0.0)  # in wave order on the main
                 while not stop.is_set():   # thread
                     try:
                         staged.put(item, timeout=0.1)
@@ -1544,22 +1618,39 @@ class MeshExecutor:
         window = 0 if jax.default_backend() == "cpu" else depth
         outs: List[DeviceGroupOutput] = []
         inflight: "deque" = deque()
+        def settle_one():
+            entry, wv, t_disp = inflight.popleft()
+            outs.append(self._settle_wave(entry))
+            # Dispatch→settle wall time: with in-flight overlap this
+            # over-counts queue time per wave, but the SUM is the true
+            # device-busy window the staging overlap hides behind.
+            self._telemetry_compute(task0, wv,
+                                    time.perf_counter() - t_disp)
+
         try:
             for w in range(nwaves):
                 if w == 0:
                     inputs = inputs0
                 else:
-                    inputs, err = staged.get()
+                    t0 = time.perf_counter()
+                    inputs, err, stage_dur = staged.get()
+                    wait = time.perf_counter() - t0
                     if err is not None:
                         raise err
+                    # Exposed staging: the part of the stager's work
+                    # this thread actually sat waiting on. Hidden =
+                    # stage_dur - exposed is the pipeline's win.
+                    self._telemetry_staging(task0, w, stage_dur,
+                                            min(wait, stage_dur))
                 self._emit_phase(task0, PHASE_WAVE_COMPUTE, w)
                 inflight.append(
-                    self._dispatch_wave(wave_tasks[w], w, inputs)
+                    (self._dispatch_wave(wave_tasks[w], w, inputs), w,
+                     time.perf_counter())
                 )
                 while len(inflight) > window:
-                    outs.append(self._settle_wave(inflight.popleft()))
+                    settle_one()
             while inflight:
-                outs.append(self._settle_wave(inflight.popleft()))
+                settle_one()
             return outs
         finally:
             stop.set()
@@ -1620,23 +1711,31 @@ class MeshExecutor:
                       inputs=None) -> DeviceGroupOutput:
         task0 = tasks[0]
         if inputs is None:
+            t0 = time.perf_counter()
             inputs = self._group_inputs(tasks, wave)
+            dur = time.perf_counter() - t0
+            # Serial staging: fully exposed (nothing overlapped it).
+            self._telemetry_staging(task0, wave, dur, dur)
+        t_run = time.perf_counter()
         self._maybe_auto_dense(task0, inputs, wave)
         budget = self.device_budget_bytes
+        out = None
         if (budget
                 and task0.num_partition > 1
                 and len(inputs) == 1 and not inputs[0][3]
                 and self._splittable_chain(task0)
                 and self._wave_bytes_estimate(task0, inputs) > budget):
-            split = self._try_execute_wave_split(
+            out = self._try_execute_wave_split(
                 tasks, wave, inputs, budget
             )
-            if split is not None:
-                return split
-        return self._execute_wave_on(
-            tasks, wave, inputs,
-            restage=lambda: self._group_inputs(tasks, wave),
-        )
+        if out is None:
+            out = self._execute_wave_on(
+                tasks, wave, inputs,
+                restage=lambda: self._group_inputs(tasks, wave),
+            )
+        self._telemetry_compute(task0, wave,
+                                time.perf_counter() - t_run)
+        return out
 
     def _splittable_chain(self, task0: Task) -> bool:
         """Row-slicing a shard is only sound for chains whose stages

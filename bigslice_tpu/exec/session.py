@@ -249,7 +249,17 @@ class Session:
         self.eventer = eventer
         self.trace_path = trace_path
         self.tracer = trace_mod.Tracer() if trace_path else None
+        # Session-scoped telemetry hub (utils/telemetry.py): subscribes
+        # to the monitor + on_phase channels below and to executor
+        # shuffle/staging seams; queried via telemetry_summary(), the
+        # status display's annotations, and /debug/metrics. Its compact
+        # skew/overlap instants ride self._event into the Chrome trace
+        # for tools/slicetrace.py.
+        from bigslice_tpu.utils import telemetry as telemetry_mod
+
+        self.telemetry = telemetry_mod.TelemetryHub(eventer=self._event)
         self.status = status_mod.Status()
+        self.status.set_telemetry(self.telemetry)
         stats_fn = getattr(self.executor, "resource_stats", None)
         if stats_fn is not None:
             self.status.set_resources_provider(stats_fn)
@@ -257,7 +267,7 @@ class Session:
         if status:
             self._printer = status_mod.StatusPrinter(self.status)
             self._printer.start()
-        monitors = [monitor, self.status]
+        monitors = [monitor, self.status, self.telemetry]
         if self.tracer is not None:
             monitors.append(trace_mod.TaskTraceMonitor(self.tracer))
         if eventer is not None:
@@ -502,6 +512,16 @@ class Session:
                 t.reset_for_retry()
         self._event("bigslice:elasticRetry", cause=repr(cause))
         return True
+
+    def telemetry_summary(self) -> dict:
+        """The telemetry hub's aggregated signals (utils/telemetry.py):
+        per-op task-duration quantiles + stragglers, shuffle-boundary
+        skew (per-shard rows/bytes, max/median ratio, hot shard), and
+        wave-pipeline overlap accounting (staging vs exposed time,
+        overlap-efficiency). bench.py records this next to throughput
+        so the perf trajectory carries overlap efficiency alongside
+        rows/sec; tests assert skew flagging through it."""
+        return self.telemetry.summary()
 
     # Go-flavored alias (Session.Must): raise on error is Python's default.
     must = run

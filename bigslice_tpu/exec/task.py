@@ -13,6 +13,7 @@ from __future__ import annotations
 import dataclasses
 import enum
 import threading
+import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from bigslice_tpu.utils import metrics as metrics_mod
@@ -121,6 +122,13 @@ class Task:
         self._subs: List[Callable] = []
         # Evaluator bookkeeping (exec/eval.go:108-159).
         self.consecutive_lost = 0
+        # Monotonic stamp of the most recent transition INTO each state
+        # (retries overwrite), written inside the transition before
+        # subscribers run — the authoritative timing source for the
+        # telemetry hub's duration quantiles and queue-latency signals
+        # (utils/telemetry.py). A dict, not fields: monitors read it
+        # without knowing the state machine's shape.
+        self.state_times: Dict[TaskState, float] = {}
 
     @property
     def num_partition(self) -> int:
@@ -141,6 +149,7 @@ class Task:
                   error: Optional[BaseException] = None) -> None:
         with self._lock:
             self._state = state
+            self.state_times[state] = time.monotonic()
             if error is not None:
                 self.error = error
             if state == TaskState.OK:
@@ -156,6 +165,7 @@ class Task:
             if self._state != frm:
                 return False
             self._state = to
+            self.state_times[to] = time.monotonic()
             self._cond.notify_all()
             subs = list(self._subs)
         for fn in subs:

@@ -9,7 +9,20 @@ prints, per invocation, the reference's report sections
 - ``invN:slice`` — per op: shard count, start offset, wall span
   (first task start → last task end);
 - ``invN:task:quartile`` — per-task duration min/q1/q2/q3/max and
-  total.
+  total;
+
+plus the telemetry-hub sections (utils/telemetry.py):
+
+- ``invN:straggler`` — per op, tasks whose duration exceeded
+  STRAGGLER_FACTOR × the op's median (computed from the task events
+  themselves, so any trace — including pre-hub ones — renders it);
+- ``invN:skew`` — per-op shuffle-boundary per-shard row totals,
+  max/median ratio and the hot shard (from ``bigslice:shuffleSizes``
+  instants the hub records);
+- ``invN:overlap`` — per-op wave-pipeline accounting: staging time,
+  the compute-exposed part, the prefetch-hidden part, and the overlap
+  efficiency percentage (from ``bigslice:waveStaging`` /
+  ``bigslice:waveRun`` instants).
 
 Traces from older sessions (no ``inv`` task args) fall back to one
 flat all-ops quartile table.
@@ -22,6 +35,11 @@ from __future__ import annotations
 import json
 import sys
 from typing import Dict, List
+
+# Straggler flagging threshold for the offline report — mirrors the
+# live hub's default (utils/telemetry.py DEFAULT_STRAGGLER_FACTOR).
+STRAGGLER_FACTOR = 3.0
+STRAGGLER_MIN_SIBLINGS = 3
 
 
 def quartiles(xs: List[float]):
@@ -61,7 +79,9 @@ def _op_rows(tasks: List[dict]):
     return rows
 
 
-def _print_inv(out: List[str], inv, summary: dict, tasks: List[dict]):
+def _print_inv(out: List[str], inv, summary: dict, tasks: List[dict],
+               telem: Dict[str, List[dict]] = None):
+    telem = telem or {}
     out.append(f"# inv{inv}:summary")
     out.append(f"  location  {summary.get('location', '?')}")
     if summary.get("args"):
@@ -82,7 +102,92 @@ def _print_inv(out: List[str], inv, summary: dict, tasks: List[dict]):
             f"  {r['op'][:28]:<28} {r['n']:>5} {mn:>9.2f} {q1:>9.2f} "
             f"{q2:>9.2f} {q3:>9.2f} {mx:>9.2f} {sum(r['durs']):>10.2f}"
         )
+    _print_straggler(out, inv, rows, tasks)
+    _print_skew(out, inv, telem.get("skew", ()))
+    _print_overlap(out, inv, telem.get("staging", ()),
+                   telem.get("runs", ()))
     out.append("")
+
+
+def _print_straggler(out: List[str], inv, rows, tasks: List[dict]):
+    """Tasks whose duration exceeded STRAGGLER_FACTOR x their op's
+    median — recomputed from the task events, so every trace renders
+    this section."""
+    out.append(f"# inv{inv}:straggler "
+               f"(task > {STRAGGLER_FACTOR:g}x op median)")
+    out.append(f"  {'op':<28} {'n':>5} {'med_ms':>9} {'max_ms':>9}  "
+               f"flagged")
+    for r in rows:
+        if len(r["durs"]) < STRAGGLER_MIN_SIBLINGS + 1:
+            continue
+        _, _, med, _, mx = quartiles(r["durs"])
+        flagged = [
+            ev for ev in tasks
+            if ev["name"] == r["op"]
+            and ev["dur"] / 1e3 > STRAGGLER_FACTOR * med
+        ]
+        names = ", ".join(
+            f"shard {ev.get('args', {}).get('shard', '?')} "
+            f"({ev['dur'] / 1e3:.1f}ms)"
+            for ev in flagged[:4]
+        ) or "-"
+        out.append(f"  {r['op'][:28]:<28} {len(r['durs']):>5} "
+                   f"{med:>9.2f} {mx:>9.2f}  {names}")
+
+
+def _print_skew(out: List[str], inv, events):
+    """Per-op shuffle-boundary skew from bigslice:shuffleSizes instants
+    (the LAST instant per op carries the accumulated totals)."""
+    last: Dict[str, dict] = {}
+    for ev in events:
+        a = ev.get("args", {})
+        if a.get("op"):
+            last[a["op"]] = a
+    if not last:
+        return
+    out.append(f"# inv{inv}:skew (per-shard rows at shuffle "
+               f"boundaries, max/median)")
+    out.append(f"  {'op':<28} {'rows':>10} {'max':>9} {'median':>9} "
+               f"{'ratio':>7} {'hot':>4}  flagged")
+    for op, a in sorted(last.items()):
+        out.append(
+            f"  {op[:28]:<28} {a.get('total_rows', 0):>10} "
+            f"{a.get('max_rows', 0):>9} {a.get('median_rows', 0):>9.0f} "
+            f"{a.get('ratio', 0):>7.2f} {a.get('max_shard', -1):>4}  "
+            f"{'YES' if a.get('flagged') else 'no'}"
+        )
+
+
+def _print_overlap(out: List[str], inv, staging, runs):
+    """Per-op wave-pipeline accounting from bigslice:waveStaging /
+    bigslice:waveRun instants: how much staging the prefetcher hid."""
+    agg: Dict[str, dict] = {}
+    for ev in staging:
+        a = ev.get("args", {})
+        d = agg.setdefault(a.get("op", "?"), {
+            "waves": 0, "ms": 0.0, "exposed_ms": 0.0, "compute_ms": 0.0,
+        })
+        d["waves"] += 1
+        d["ms"] += a.get("ms", 0.0)
+        d["exposed_ms"] += a.get("exposed_ms", 0.0)
+    for ev in runs:
+        a = ev.get("args", {})
+        if a.get("op") in agg:
+            agg[a["op"]]["compute_ms"] += a.get("ms", 0.0)
+    if not agg:
+        return
+    out.append(f"# inv{inv}:overlap (wave staging hidden by prefetch)")
+    out.append(f"  {'op':<28} {'waves':>5} {'stage_ms':>9} "
+               f"{'expos_ms':>9} {'hide_ms':>9} {'comp_ms':>9} "
+               f"{'overlap':>8}")
+    for op, d in sorted(agg.items()):
+        hidden = max(0.0, d["ms"] - d["exposed_ms"])
+        eff = hidden / d["ms"] if d["ms"] > 0 else 0.0
+        out.append(
+            f"  {op[:28]:<28} {d['waves']:>5} {d['ms']:>9.2f} "
+            f"{d['exposed_ms']:>9.2f} {hidden:>9.2f} "
+            f"{d['compute_ms']:>9.2f} {eff:>7.1%}"
+        )
 
 
 def analyze(path: str) -> str:
@@ -90,6 +195,12 @@ def analyze(path: str) -> str:
         doc = json.load(fp)
     tasks_by_inv: Dict[object, List[dict]] = {}
     summaries: Dict[object, dict] = {}
+    telem_by_inv: Dict[object, Dict[str, List[dict]]] = {}
+    _telem_names = {
+        "bigslice:shuffleSizes": "skew",
+        "bigslice:waveStaging": "staging",
+        "bigslice:waveRun": "runs",
+    }
     n_tasks = n_instants = 0
     for ev in doc.get("traceEvents", []):
         if ev.get("ph") == "X":
@@ -99,12 +210,21 @@ def analyze(path: str) -> str:
         elif ev.get("ph") == "i":
             n_instants += 1
             args = ev.get("args", {})
-            if str(ev.get("name", "")).startswith("bigslice:invocation:"):
+            name = str(ev.get("name", ""))
+            if name.startswith("bigslice:invocation:"):
                 summaries[args.get("inv")] = args
+            elif name in _telem_names:
+                telem_by_inv.setdefault(
+                    args.get("inv"), {}
+                ).setdefault(_telem_names[name], []).append(ev)
     out = [f"{path}: {n_tasks} task runs, {n_instants} events"]
-    known = sorted(k for k in tasks_by_inv if k is not None)
+    known = sorted(
+        k for k in set(tasks_by_inv) | set(telem_by_inv)
+        if k is not None
+    )
     for inv in known:
-        _print_inv(out, inv, summaries.get(inv, {}), tasks_by_inv[inv])
+        _print_inv(out, inv, summaries.get(inv, {}),
+                   tasks_by_inv.get(inv, []), telem_by_inv.get(inv))
     legacy = tasks_by_inv.get(None)
     if legacy:
         # Pre-inv-tagging traces: no invocation identity exists, so

@@ -1,10 +1,14 @@
-"""Debug HTTP server: live status, task DAG, trace download.
+"""Debug HTTP server: live status, task DAG, trace download, metrics.
 
 Mirrors the reference's debug endpoints (exec/graph.go:15-100,
 exec/session.go:376-389): ``/debug`` (index), ``/debug/status`` (live
 per-op task counts), ``/debug/tasks`` (task DAG as JSON, the d3
 force-graph data source), ``/debug/trace`` (Chrome trace JSON of the
-session so far).
+session so far), ``/debug/resources`` (executor resource gauges), and
+``/debug/metrics`` (the telemetry hub's signals in Prometheus text
+exposition format — task-state counters, per-op skew ratio and
+duration quantiles, wave overlap-efficiency gauges — for scrape-based
+production monitoring).
 """
 
 from __future__ import annotations
@@ -36,6 +40,8 @@ class DebugServer:
                         "/debug/trace   chrome trace (json)\n"
                         "/debug/resources  HBM/RSS/combiner gauges "
                         "(json)\n"
+                        "/debug/metrics  telemetry in Prometheus text "
+                        "format\n"
                     )
                     self._send(200, "text/plain", body)
                 elif self.path == "/debug/status":
@@ -51,6 +57,12 @@ class DebugServer:
                     stats = stats_fn() if stats_fn is not None else {}
                     self._send(200, "application/json",
                                json.dumps(stats))
+                elif self.path == "/debug/metrics":
+                    hub = getattr(server.session, "telemetry", None)
+                    text = hub.prometheus_text() if hub else ""
+                    self._send(
+                        200, "text/plain; version=0.0.4", text
+                    )
                 elif self.path == "/debug/trace":
                     tracer = server.session.tracer
                     events = tracer.events() if tracer else []

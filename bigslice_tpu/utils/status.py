@@ -16,6 +16,44 @@ from typing import Dict, Optional
 
 from bigslice_tpu.exec.task import TaskState
 
+# Monitors whose callback already raised once (and were logged): further
+# exceptions from the same monitor are muted so a broken status display
+# can't flood stderr at one line per task transition. id-keyed, with a
+# strong reference to the callback held so a recycled object id can
+# never silently mute a NEW monitor's first exception; bounded — past
+# the cap we fail open to logging (noisy beats silent).
+_monitor_warned: dict = {}  # key -> callback (the ref pins the id)
+_monitor_warned_lock = threading.Lock()
+_MONITOR_WARNED_MAX = 256
+
+
+def safe_monitor_call(fn, *args, key=None) -> None:
+    """Invoke a monitor/phase callback, swallowing (and logging once per
+    callback) any exception: observability hooks run inside the
+    evaluator's transition path and the wave pipeline's prefetcher
+    thread, where a raising monitor would otherwise kill the evaluation
+    or wedge staging (chain_monitors / exec/evaluate.notify_phase).
+
+    ``key`` identifies the callback for the log-once bookkeeping; pass
+    it when ``fn`` is a transient bound-method object (a fresh object —
+    and id — per attribute access)."""
+    try:
+        fn(*args)
+    except Exception:
+        key = id(fn) if key is None else key
+        with _monitor_warned_lock:
+            first = key not in _monitor_warned
+            if first and len(_monitor_warned) < _MONITOR_WARNED_MAX:
+                _monitor_warned[key] = fn
+        if first:
+            import traceback
+
+            print(
+                f"bigslice: monitor {fn!r} raised (suppressed; further "
+                f"errors from it are muted):", file=sys.stderr,
+            )
+            traceback.print_exc(file=sys.stderr)
+
 
 class Status:
     """Aggregated task counts per op group."""
@@ -36,9 +74,15 @@ class Status:
         # carries HBM / RSS / combiner gauges next to the task counts
         # (exec/slicemachine.go:238-257 role).
         self._resources_provider = None
+        # Telemetry hub (utils/telemetry.py): when wired, render()
+        # carries live skew / straggler annotations next to the counts.
+        self._telemetry = None
 
     def set_resources_provider(self, provider) -> None:
         self._resources_provider = provider
+
+    def set_telemetry(self, hub) -> None:
+        self._telemetry = hub
 
     _TERMINAL = (TaskState.OK, TaskState.ERR, TaskState.LOST)
 
@@ -93,6 +137,12 @@ class Status:
                 line += f", {err} failed/lost"
             line += f" [{self.elapsed(op):.1f}s]"
             lines.append(line)
+        hub = self._telemetry
+        if hub is not None:
+            try:
+                lines.extend(hub.status_lines())
+            except Exception:
+                pass  # best-effort; never break the status line
         provider = self._resources_provider
         if provider is not None:
             try:
@@ -114,30 +164,50 @@ class StatusPrinter:
         self.stream = stream or sys.stderr
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._last_key = ""
+        self._last_render = ""
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
-    def _loop(self) -> None:
+    @staticmethod
+    def _dedup_key(rendered: str) -> str:
+        # Dedup modulo the ticking elapsed field: a long-running op
+        # must not reprint an otherwise-identical block every
+        # interval (non-TTY logs would fill with timestamp-only
+        # changes).
         import re
 
-        last = ""
+        return re.sub(r"\[\d+\.\d+s\]", "[]", rendered)
+
+    def _loop(self) -> None:
         while not self._stop.wait(self.interval):
-            cur = self.status.render()
-            # Dedup modulo the ticking elapsed field: a long-running op
-            # must not reprint an otherwise-identical block every
-            # interval (non-TTY logs would fill with timestamp-only
-            # changes).
-            key = re.sub(r"\[\d+\.\d+s\]", "[]", cur)
-            if cur and key != last:
-                print(cur, file=self.stream, flush=True)
-                last = key
+            self._print_once()
+
+    def _print_once(self) -> None:
+        cur = self.status.render()
+        key = self._dedup_key(cur)
+        if cur and key != self._last_key:
+            print(cur, file=self.stream, flush=True)
+            self._last_key = key
+            self._last_render = cur
 
     def stop(self) -> None:
         self._stop.set()
         if self._thread:
             self._thread.join(timeout=2)
+        # One final snapshot: a session shorter than the print interval
+        # (or one whose last transitions landed after the final tick)
+        # must not exit with a stale — or empty — last status block.
+        try:
+            cur = self.status.render()
+            if cur and cur != self._last_render:
+                print(cur, file=self.stream, flush=True)
+                self._last_key = self._dedup_key(cur)
+                self._last_render = cur
+        except Exception:
+            pass  # never let a final render break shutdown
 
 
 def chain_monitors(*monitors):
@@ -145,19 +215,25 @@ def chain_monitors(*monitors):
 
     Members exposing ``on_phase`` (the wave-pipeline phase channel,
     exec/evaluate.notify_phase) get a composed forwarder on the chained
-    monitor; state-only members are untouched by phase events."""
+    monitor; state-only members are untouched by phase events.
+
+    Every member call is isolated through ``safe_monitor_call``: one
+    raising monitor must neither starve the members after it nor
+    propagate into the evaluator's transition path or the wave
+    pipeline's prefetcher thread."""
     mons = [m for m in monitors if m is not None]
 
     def monitor(task, state):
         for m in mons:
-            m(task, state)
+            safe_monitor_call(m, task, state)
 
     phase_mons = [m for m in mons
                   if getattr(m, "on_phase", None) is not None]
     if phase_mons:
         def on_phase(task, phase, wave):
             for m in phase_mons:
-                m.on_phase(task, phase, wave)
+                safe_monitor_call(m.on_phase, task, phase, wave,
+                                  key=id(m))
 
         monitor.on_phase = on_phase
     return monitor

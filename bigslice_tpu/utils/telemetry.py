@@ -1,0 +1,583 @@
+"""Session-scoped telemetry hub: skew, stragglers, wave overlap.
+
+The reference surfaces *raw* observability channels — task-state
+transitions (base/status), Chrome traces (exec/tracer.go), per-machine
+resource gauges (exec/slicemachine.go:238-257) — but leaves their
+interpretation to the operator. At production scale the questions that
+matter are already aggregates: is this shuffle skewed, which shard is
+the straggler, and how much of the wave pipeline's prefetch window
+actually hides compute. ``TelemetryHub`` subscribes to the existing
+channels (the ``(task, state)`` monitor chain, the ``on_phase`` wave
+channel of exec/evaluate.py, and executor shuffle/staging seams) and
+computes three actionable signal families:
+
+1. **Shuffle skew** — per-shard row/byte sizes at every shuffle
+   boundary, accumulated per op, with a skew ratio (max/median) and the
+   hot shard's index. Executors report at their natural boundary: the
+   local tier reports rows *routed* per partition (pre-combine — the
+   honest work signal for combiner-bearing shuffles), the mesh tier
+   reports per-device output counts (post-combine for fused
+   shuffle+combine programs; multi-process meshes skip the host-side
+   count sync entirely).
+2. **Stragglers** — per-task duration quantiles per op (from the
+   authoritative ``Task.state_times`` stamps), flagging a completed
+   task whose duration exceeds ``straggler_factor`` × the p50 of its
+   op's previously-completed siblings, and (live) a RUNNING task whose
+   elapsed time already does.
+3. **Wave-overlap accounting** — per staged wave, total staging time
+   vs. the portion the compute thread actually *waited* on it
+   (exposed). ``hidden / total`` is the pipeline's overlap-efficiency:
+   1.0 means prefetch fully hid staging behind compute, 0.0 is the
+   serial executor.
+
+Surfaced three ways: ``prometheus_text()`` (the ``/debug/metrics``
+endpoint of utils/debughttp.py), ``status_lines()`` (live skew /
+straggler annotations in the utils/status.py display), and
+``summary()`` (the ``Session.telemetry_summary()`` dict that bench.py
+records next to throughput numbers). Each record additionally emits a
+compact instant event through the session's eventer/tracer so
+``tools/slicetrace.py`` can render skew/overlap sections offline.
+
+All entry points are exception-safe by design (telemetry must never
+take down an evaluation) and cheap: O(shards) per shuffle boundary,
+O(1) per task transition amortized.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+# Flagging thresholds. Deliberately conservative defaults: a production
+# alert that fires on balanced workloads is worse than none. Tests (and
+# operators) tune per-hub attributes directly.
+DEFAULT_SKEW_RATIO = 4.0          # max/median per-shard rows
+DEFAULT_SKEW_MIN_ROWS = 512       # don't flag toy shuffles
+DEFAULT_STRAGGLER_FACTOR = 3.0    # task > k * p50(completed siblings)
+DEFAULT_STRAGGLER_MIN_SIBLINGS = 3
+DEFAULT_STRAGGLER_MIN_SECS = 0.05  # 3x of a 1ms task is noise
+
+# Prometheus histogram buckets for per-shard shuffle sizes.
+ROWS_BUCKETS = (100, 1_000, 10_000, 100_000, 1_000_000, 10_000_000)
+
+# Retained per-op records. Iterative drivers mint fresh ``#N``-suffixed
+# op names every invocation, so a week-long session would otherwise
+# grow the hub without bound; oldest ops (insertion order) evict first,
+# Prometheus-counter monotonicity be damned — an evicted op is one
+# nobody scraped for hundreds of invocations.
+MAX_OPS = 1024
+
+
+def quantile(sorted_xs: List[float], p: float) -> float:
+    """Linear-interpolated quantile of an ascending list."""
+    n = len(sorted_xs)
+    if n == 0:
+        return 0.0
+    if n == 1:
+        return sorted_xs[0]
+    i = p * (n - 1)
+    lo = int(i)
+    hi = min(lo + 1, n - 1)
+    return sorted_xs[lo] + (sorted_xs[hi] - sorted_xs[lo]) * (i - lo)
+
+
+def _escape_label(v: str) -> str:
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+class _OpRecord:
+    """Per-op accumulation (one instance per distinct op name; iterative
+    drivers re-invoke under fresh ``#N``-suffixed names, so an op key is
+    naturally per-invocation-site-per-run)."""
+
+    def __init__(self, inv: Optional[int] = None):
+        self.inv = inv
+        # -- task durations / stragglers
+        self.durations: List[float] = []      # completed (OK) tasks
+        self.running: Dict[str, float] = {}   # task key -> start stamp
+        self.shards: Dict[str, int] = {}      # task key -> shard index
+        self.stragglers: List[dict] = []
+        # -- shuffle sizes (elementwise-accumulated across producers)
+        self.part_rows: List[int] = []
+        self.part_bytes: List[int] = []
+        self.shuffle_boundaries = 0
+        self.worst_ratio = 0.0
+        self.worst_max_shard = -1
+        self.skew_flagged = False
+        self.rows_hist = [0] * (len(ROWS_BUCKETS) + 1)
+        self.rows_hist_sum = 0
+        self.rows_hist_count = 0
+        # -- wave pipeline accounting
+        self.staging_s = 0.0
+        self.exposed_s = 0.0
+        self.compute_s = 0.0
+        self.staged_waves = 0
+        self.max_wave = -1
+        self.phase_counts: Dict[str, int] = {}
+
+
+class TelemetryHub:
+    """The aggregation layer. Participates in the monitor chain (it is
+    a ``(task, state)`` callable exposing ``on_phase``) and receives
+    executor seam calls (``record_shuffle`` / ``record_wave_staging`` /
+    ``record_wave_compute``)."""
+
+    def __init__(self, eventer=None,
+                 skew_ratio: float = DEFAULT_SKEW_RATIO,
+                 skew_min_rows: int = DEFAULT_SKEW_MIN_ROWS,
+                 straggler_factor: float = DEFAULT_STRAGGLER_FACTOR,
+                 straggler_min_siblings: int =
+                 DEFAULT_STRAGGLER_MIN_SIBLINGS,
+                 straggler_min_secs: float = DEFAULT_STRAGGLER_MIN_SECS):
+        self._lock = threading.Lock()
+        self._ops: Dict[str, _OpRecord] = {}
+        self._state_counts: Dict[tuple, int] = {}
+        self._eventer = eventer
+        self.skew_ratio = skew_ratio
+        self.skew_min_rows = skew_min_rows
+        self.straggler_factor = straggler_factor
+        self.straggler_min_siblings = straggler_min_siblings
+        self.straggler_min_secs = straggler_min_secs
+
+    def _op(self, op: str, inv: Optional[int] = None) -> _OpRecord:
+        rec = self._ops.get(op)
+        if rec is None:
+            while len(self._ops) >= MAX_OPS:
+                evicted = next(iter(self._ops))
+                del self._ops[evicted]
+                for k in [k for k in self._state_counts
+                          if k[0] == evicted]:
+                    del self._state_counts[k]
+            rec = self._ops[op] = _OpRecord(inv)
+        if rec.inv is None:
+            rec.inv = inv
+        return rec
+
+    def _emit(self, name: str, **fields) -> None:
+        ev = self._eventer
+        if ev is None:
+            return
+        try:
+            ev(name, **fields)
+        except Exception:  # telemetry must never break the run
+            pass
+
+    # -- monitor protocol (chained by Session) ----------------------------
+
+    def __call__(self, task, state) -> None:
+        from bigslice_tpu.exec.task import TaskState
+
+        now = time.monotonic()
+        key = str(task.name)
+        straggler = None
+        with self._lock:
+            sk = (task.name.op, state.name)
+            self._state_counts[sk] = self._state_counts.get(sk, 0) + 1
+            rec = self._op(task.name.op, task.name.inv_index)
+            if state == TaskState.RUNNING:
+                # Task.state_times is authoritative (stamped inside the
+                # transition, before subscribers run); our own stamp is
+                # the fallback for hand-rolled tasks in tests.
+                times = getattr(task, "state_times", None) or {}
+                rec.running[key] = times.get(TaskState.RUNNING, now)
+                rec.shards[key] = task.name.shard
+            elif state == TaskState.OK:
+                start = rec.running.pop(key, None)
+                if start is not None:
+                    # End stamp from state_times too: the hub may be
+                    # called after slower chain members, and that
+                    # monitor latency must not inflate durations (or
+                    # mint false stragglers on fast ops).
+                    times = getattr(task, "state_times", None) or {}
+                    dur = max(0.0, times.get(TaskState.OK, now) - start)
+                    siblings = sorted(rec.durations)
+                    rec.durations.append(dur)
+                    if (len(siblings) >= self.straggler_min_siblings
+                            and dur >= self.straggler_min_secs):
+                        p50 = quantile(siblings, 0.5)
+                        if dur > self.straggler_factor * p50:
+                            straggler = {
+                                "task": key,
+                                "shard": rec.shards.get(key, -1),
+                                "duration_s": round(dur, 6),
+                                "p50_s": round(p50, 6),
+                            }
+                            rec.stragglers.append(straggler)
+            elif state in (TaskState.ERR, TaskState.LOST):
+                rec.running.pop(key, None)
+        if straggler is not None:
+            self._emit("bigslice:straggler", op=task.name.op,
+                       inv=task.name.inv_index, **straggler)
+
+    def on_phase(self, task, phase: str, wave: int) -> None:
+        with self._lock:
+            rec = self._op(task.name.op, task.name.inv_index)
+            rec.phase_counts[phase] = rec.phase_counts.get(phase, 0) + 1
+            rec.max_wave = max(rec.max_wave, int(wave))
+
+    # -- executor seams ---------------------------------------------------
+
+    def record_shuffle(self, op: str, inv: Optional[int],
+                       rows, nbytes=None) -> None:
+        """One producer's (or one whole group's) per-partition sizes at
+        a shuffle boundary. Contributions accumulate elementwise per op,
+        so per-producer host-tier calls and single whole-group mesh
+        calls land in the same per-op partition-size vector."""
+        rows = [max(0, int(r)) for r in rows]
+        if not rows:
+            return
+        if nbytes is None:
+            nbytes = [0] * len(rows)
+        nbytes = [max(0, int(b)) for b in nbytes]
+        with self._lock:
+            rec = self._op(op, inv)
+            if len(rec.part_rows) < len(rows):
+                rec.part_rows.extend(
+                    [0] * (len(rows) - len(rec.part_rows)))
+                rec.part_bytes.extend(
+                    [0] * (len(rows) - len(rec.part_bytes)))
+            for i, r in enumerate(rows):
+                rec.part_rows[i] += r
+            for i, b in enumerate(nbytes):
+                rec.part_bytes[i] += b
+            rec.shuffle_boundaries += 1
+            for r in rows:  # histogram observes per-shard sizes
+                for bi, le in enumerate(ROWS_BUCKETS):
+                    if r <= le:
+                        rec.rows_hist[bi] += 1
+                        break
+                else:
+                    rec.rows_hist[-1] += 1
+                rec.rows_hist_sum += r
+                rec.rows_hist_count += 1
+            ratio, max_shard, median, total = self._skew_of(
+                rec.part_rows
+            )
+            max_rows = rec.part_rows[max_shard]
+            if ratio > rec.worst_ratio:
+                rec.worst_ratio = ratio
+                rec.worst_max_shard = max_shard
+            flagged = (total >= self.skew_min_rows
+                       and ratio >= self.skew_ratio)
+            rec.skew_flagged = rec.skew_flagged or flagged
+        # All accumulated-vector values (this call's contribution is
+        # already folded in) so slicetrace's last-event-per-op view
+        # reads the op's final state.
+        self._emit(
+            "bigslice:shuffleSizes", op=op, inv=inv,
+            rows=rows if len(rows) <= 64 else None,
+            total_rows=total, max_rows=max_rows, median_rows=median,
+            ratio=round(ratio, 3), max_shard=max_shard,
+            flagged=flagged,
+        )
+
+    @staticmethod
+    def _skew_of(rows: List[int]):
+        total = sum(rows)
+        mx = max(rows)
+        max_shard = rows.index(mx)
+        median = quantile(sorted(float(r) for r in rows), 0.5)
+        ratio = mx / max(median, 1.0)
+        return ratio, max_shard, median, total
+
+    def record_wave_staging(self, op: str, inv: Optional[int],
+                            wave: int, dur_s: float,
+                            exposed_s: float) -> None:
+        """One wave's input staging: total duration, and the portion the
+        compute thread actually blocked on (== dur_s on the serial
+        path; the wait in ``staged.get()`` on the pipelined path)."""
+        dur_s = max(0.0, float(dur_s))
+        exposed_s = min(max(0.0, float(exposed_s)), dur_s)
+        with self._lock:
+            rec = self._op(op, inv)
+            rec.staging_s += dur_s
+            rec.exposed_s += exposed_s
+            rec.staged_waves += 1
+            rec.max_wave = max(rec.max_wave, int(wave))
+        self._emit("bigslice:waveStaging", op=op, inv=inv, wave=wave,
+                   ms=round(dur_s * 1e3, 3),
+                   exposed_ms=round(exposed_s * 1e3, 3))
+
+    def record_wave_compute(self, op: str, inv: Optional[int],
+                            wave: int, dur_s: float) -> None:
+        dur_s = max(0.0, float(dur_s))
+        with self._lock:
+            rec = self._op(op, inv)
+            rec.compute_s += dur_s
+            rec.max_wave = max(rec.max_wave, int(wave))
+        self._emit("bigslice:waveRun", op=op, inv=inv, wave=wave,
+                   ms=round(dur_s * 1e3, 3))
+
+    # -- queries ----------------------------------------------------------
+
+    def live_stragglers(self) -> List[dict]:
+        """RUNNING tasks whose elapsed time already exceeds the
+        straggler threshold of their op's completed siblings."""
+        now = time.monotonic()
+        out = []
+        with self._lock:
+            for op, rec in self._ops.items():
+                if len(rec.durations) < self.straggler_min_siblings:
+                    continue
+                p50 = quantile(sorted(rec.durations), 0.5)
+                floor = max(self.straggler_factor * p50,
+                            self.straggler_min_secs)
+                for key, start in rec.running.items():
+                    elapsed = now - start
+                    if elapsed > floor:
+                        out.append({
+                            "op": op, "task": key,
+                            "shard": rec.shards.get(key, -1),
+                            "elapsed_s": round(elapsed, 3),
+                            "p50_s": round(p50, 6),
+                        })
+        out.sort(key=lambda d: -d["elapsed_s"])
+        return out
+
+    def summary(self) -> dict:
+        """The ``Session.telemetry_summary()`` payload: per-op skew /
+        straggler / wave sections plus session-wide rollups."""
+        with self._lock:
+            ops = {}
+            total_staging = total_hidden = 0.0
+            flagged_ops = []
+            straggler_total = 0
+            for op, rec in self._ops.items():
+                entry: dict = {"inv": rec.inv}
+                if rec.durations:
+                    ds = sorted(rec.durations)
+                    entry["tasks"] = {
+                        "n": len(ds),
+                        "p50_s": round(quantile(ds, 0.5), 6),
+                        "p90_s": round(quantile(ds, 0.9), 6),
+                        "max_s": round(ds[-1], 6),
+                        "total_s": round(sum(ds), 6),
+                    }
+                if rec.stragglers:
+                    entry["stragglers"] = list(rec.stragglers)
+                    straggler_total += len(rec.stragglers)
+                if rec.part_rows:
+                    ratio, max_shard, median, total = self._skew_of(
+                        rec.part_rows
+                    )
+                    flagged = (total >= self.skew_min_rows
+                               and ratio >= self.skew_ratio)
+                    entry["skew"] = {
+                        "rows": list(rec.part_rows),
+                        "bytes": list(rec.part_bytes),
+                        "total_rows": total,
+                        "median_rows": median,
+                        "ratio": round(ratio, 3),
+                        "max_shard": max_shard,
+                        "flagged": flagged,
+                        "boundaries": rec.shuffle_boundaries,
+                    }
+                    if flagged:
+                        flagged_ops.append(op)
+                if rec.staged_waves or rec.max_wave >= 0:
+                    hidden = max(0.0, rec.staging_s - rec.exposed_s)
+                    eff = (hidden / rec.staging_s
+                           if rec.staging_s > 0 else 0.0)
+                    entry["waves"] = {
+                        "n_waves": rec.max_wave + 1,
+                        "staged": rec.staged_waves,
+                        "staging_s": round(rec.staging_s, 6),
+                        "exposed_s": round(rec.exposed_s, 6),
+                        "hidden_s": round(hidden, 6),
+                        "compute_s": round(rec.compute_s, 6),
+                        "overlap_efficiency": round(eff, 4),
+                        "phases": dict(rec.phase_counts),
+                    }
+                    total_staging += rec.staging_s
+                    total_hidden += hidden
+                ops[op] = entry
+            states: Dict[str, int] = {}
+            for (_, st), n in self._state_counts.items():
+                states[st] = states.get(st, 0) + n
+            return {
+                "ops": ops,
+                "task_states": states,
+                "skew_flagged_ops": sorted(flagged_ops),
+                "straggler_total": straggler_total,
+                "overlap_efficiency": round(
+                    total_hidden / total_staging, 4
+                ) if total_staging > 0 else None,
+            }
+
+    def status_lines(self, limit: int = 4) -> List[str]:
+        """Live annotations for the status display: flagged skew and
+        current/flagged stragglers, worst first, bounded."""
+        lines: List[str] = []
+        with self._lock:
+            skews = []
+            for op, rec in self._ops.items():
+                if rec.skew_flagged:
+                    skews.append((rec.worst_ratio, op,
+                                  rec.worst_max_shard))
+            for ratio, op, shard in sorted(skews, reverse=True)[:limit]:
+                lines.append(
+                    f"  skew {op}: ratio {ratio:.1f} (hot shard {shard})"
+                )
+            flagged = [
+                (s["duration_s"], s["task"], s["p50_s"])
+                for rec in self._ops.values() for s in rec.stragglers
+            ]
+        for dur, task, p50 in sorted(flagged, reverse=True)[:limit]:
+            lines.append(
+                f"  straggler {task}: {dur:.2f}s vs p50 {p50:.2f}s"
+            )
+        for s in self.live_stragglers()[:limit]:
+            lines.append(
+                f"  straggler (live) {s['task']}: {s['elapsed_s']:.2f}s"
+                f" vs p50 {s['p50_s']:.2f}s"
+            )
+        return lines
+
+    # -- Prometheus export ------------------------------------------------
+
+    def prometheus_text(self) -> str:
+        """The hub's signals in Prometheus text exposition format
+        (text/plain; version=0.0.4) — counters, gauges, a per-op task
+        duration summary, and a per-op shuffle-size histogram — plus
+        the framework's internal stats.Map counters and host RSS."""
+        from bigslice_tpu.utils import resources as resources_mod
+        from bigslice_tpu.utils import stats as stats_mod
+
+        out: List[str] = []
+
+        def metric(name, help_, type_):
+            out.append(f"# HELP {name} {help_}")
+            out.append(f"# TYPE {name} {type_}")
+
+        def line(name, labels, value):
+            if labels:
+                lab = ",".join(
+                    f'{k}="{_escape_label(v)}"'
+                    for k, v in labels.items()
+                )
+                out.append(f"{name}{{{lab}}} {value}")
+            else:
+                out.append(f"{name} {value}")
+
+        with self._lock:
+            states = sorted(self._state_counts.items())
+            ops = {op: rec for op, rec in self._ops.items()}
+
+            metric("bigslice_task_state_total",
+                   "Task state transitions observed, by op and state.",
+                   "counter")
+            for (op, st), n in states:
+                line("bigslice_task_state_total",
+                     {"op": op, "state": st}, n)
+
+            metric("bigslice_task_duration_seconds",
+                   "Completed task durations per op.", "summary")
+            for op, rec in ops.items():
+                if not rec.durations:
+                    continue
+                ds = sorted(rec.durations)
+                for q in (0.5, 0.9, 0.99):
+                    line("bigslice_task_duration_seconds",
+                         {"op": op, "quantile": str(q)},
+                         f"{quantile(ds, q):.6f}")
+                line("bigslice_task_duration_seconds_sum", {"op": op},
+                     f"{sum(ds):.6f}")
+                line("bigslice_task_duration_seconds_count", {"op": op},
+                     len(ds))
+
+            metric("bigslice_op_straggler_total",
+                   "Tasks flagged as stragglers "
+                   "(duration > factor * sibling p50).", "counter")
+            for op, rec in ops.items():
+                if rec.stragglers:
+                    line("bigslice_op_straggler_total", {"op": op},
+                         len(rec.stragglers))
+
+            metric("bigslice_op_skew_ratio",
+                   "Worst max/median per-shard row ratio observed at "
+                   "this op's shuffle boundary.", "gauge")
+            for op, rec in ops.items():
+                if rec.part_rows:
+                    line("bigslice_op_skew_ratio", {"op": op},
+                         f"{rec.worst_ratio:.4f}")
+            metric("bigslice_op_skew_flagged",
+                   "1 when the op's shuffle skew exceeded the flag "
+                   "threshold.", "gauge")
+            for op, rec in ops.items():
+                if rec.part_rows:
+                    line("bigslice_op_skew_flagged", {"op": op},
+                         int(rec.skew_flagged))
+
+            metric("bigslice_shuffle_partition_rows",
+                   "Per-shard row counts observed at shuffle "
+                   "boundaries.", "histogram")
+            for op, rec in ops.items():
+                if rec.rows_hist_count == 0:
+                    continue
+                cum = 0
+                for bi, le in enumerate(ROWS_BUCKETS):
+                    cum += rec.rows_hist[bi]
+                    line("bigslice_shuffle_partition_rows_bucket",
+                         {"op": op, "le": str(le)}, cum)
+                cum += rec.rows_hist[-1]
+                line("bigslice_shuffle_partition_rows_bucket",
+                     {"op": op, "le": "+Inf"}, cum)
+                line("bigslice_shuffle_partition_rows_sum", {"op": op},
+                     rec.rows_hist_sum)
+                line("bigslice_shuffle_partition_rows_count",
+                     {"op": op}, rec.rows_hist_count)
+
+            metric("bigslice_wave_overlap_efficiency",
+                   "Fraction of wave staging time hidden behind "
+                   "compute by the prefetch pipeline (1.0 = fully "
+                   "hidden, 0.0 = serial).", "gauge")
+            for op, rec in ops.items():
+                if rec.staged_waves:
+                    hidden = max(0.0, rec.staging_s - rec.exposed_s)
+                    eff = (hidden / rec.staging_s
+                           if rec.staging_s > 0 else 0.0)
+                    line("bigslice_wave_overlap_efficiency", {"op": op},
+                         f"{eff:.4f}")
+
+            metric("bigslice_wave_staging_seconds_total",
+                   "Cumulative wave input staging time, split into "
+                   "compute-exposed and prefetch-hidden.", "counter")
+            for op, rec in ops.items():
+                if rec.staged_waves:
+                    line("bigslice_wave_staging_seconds_total",
+                         {"op": op, "kind": "exposed"},
+                         f"{rec.exposed_s:.6f}")
+                    line("bigslice_wave_staging_seconds_total",
+                         {"op": op, "kind": "hidden"},
+                         f"{max(0.0, rec.staging_s - rec.exposed_s):.6f}")
+
+            metric("bigslice_wave_compute_seconds_total",
+                   "Cumulative wave compute (dispatch to settle) time.",
+                   "counter")
+            for op, rec in ops.items():
+                if rec.compute_s > 0:
+                    line("bigslice_wave_compute_seconds_total",
+                         {"op": op}, f"{rec.compute_s:.6f}")
+
+            metric("bigslice_wave_phase_total",
+                   "Wave pipeline phase events per op "
+                   "(wavePrefetch/waveCompute).", "counter")
+            for op, rec in ops.items():
+                for phase, n in sorted(rec.phase_counts.items()):
+                    line("bigslice_wave_phase_total",
+                         {"op": op, "phase": phase}, n)
+
+        metric("bigslice_stat_total",
+               "Framework-internal stats.Map counters.", "counter")
+        for name, v in sorted(stats_mod.DEFAULT.snapshot().items()):
+            line("bigslice_stat_total", {"name": name}, v)
+
+        rss = resources_mod.host_rss_bytes()
+        if rss is not None:
+            metric("bigslice_host_rss_bytes",
+                   "Driver process resident set size.", "gauge")
+            line("bigslice_host_rss_bytes", {}, rss)
+        out.append("")
+        return "\n".join(out)

@@ -27,6 +27,12 @@ class Tracer:
         self._open: Dict[str, dict] = {}
         self._tids: Dict[str, int] = {}
         self._free_tids: List[int] = []
+        # Monotonic allocator for fresh lanes. Deriving a fresh tid from
+        # len(_tids)+1 collides with a LIVE lane after mixed begin/end
+        # interleavings (a re-begun key overwrites its _tids entry,
+        # leaking the old tid without freeing it, so len(_tids) no
+        # longer bounds the live tid set).
+        self._next_tid = 1
         self._t0 = time.perf_counter()
 
     def _now_us(self) -> float:
@@ -35,8 +41,11 @@ class Tracer:
     def begin(self, key: str, name: str, pid: str = "executor",
               **args) -> None:
         with self._lock:
-            tid = (self._free_tids.pop()
-                   if self._free_tids else len(self._tids) + 1)
+            if self._free_tids:
+                tid = self._free_tids.pop()
+            else:
+                tid = self._next_tid
+                self._next_tid += 1
             self._tids[key] = tid
             self._open[key] = {
                 "name": name,

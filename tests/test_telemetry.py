@@ -1,0 +1,412 @@
+"""Telemetry hub tests: skew detection, straggler flagging, wave
+overlap accounting, monitor-channel hardening, tracer lane allocation,
+status printer final snapshot (utils/telemetry.py and friends)."""
+
+import io
+import json
+import time
+
+import numpy as np
+import pytest
+
+import bigslice_tpu as bs
+from bigslice_tpu.exec.session import Session
+from bigslice_tpu.exec.task import TaskName, TaskState
+from bigslice_tpu.utils import telemetry as telemetry_mod
+
+
+def _mesh_session(**kwargs):
+    import jax
+    from jax.sharding import Mesh
+
+    from bigslice_tpu.exec.meshexec import MeshExecutor
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("shards",))
+    return Session(executor=MeshExecutor(mesh), **kwargs)
+
+
+# --------------------------------------------------------------- skew
+
+def test_hot_key_workload_flagged_hot_shard_identified():
+    """Acceptance: a synthetic hot-key shuffle is flagged by the skew
+    detector and the hot shard is identified in telemetry_summary();
+    see test_balanced_workload_not_flagged for the negative."""
+    sess = Session()
+    n = 20000
+    keys = np.zeros(n, dtype=np.int32)  # ~90% of rows on key 0
+    keys[: n // 10] = np.arange(n // 10, dtype=np.int32) % 97 + 1
+    res = sess.run(bs.Reduce(bs.Const(8, keys, np.ones(n, np.int32)),
+                             lambda a, b: a + b))
+    summary = sess.telemetry_summary()
+    assert summary["skew_flagged_ops"], summary["ops"].keys()
+    op = summary["skew_flagged_ops"][0]
+    skew = summary["ops"][op]["skew"]
+    assert skew["flagged"]
+    assert skew["ratio"] >= telemetry_mod.DEFAULT_SKEW_RATIO
+    # The hot shard is the partition key 0 hashes to — identified, and
+    # it holds the max row count.
+    hot = skew["max_shard"]
+    assert skew["rows"][hot] == max(skew["rows"])
+    assert skew["rows"][hot] >= 0.8 * sum(skew["rows"])
+    # Bytes accounting rides along (local tier: routed bytes).
+    assert sum(skew["bytes"]) > 0
+    res.discard()
+
+
+def test_balanced_workload_not_flagged():
+    sess = Session()
+    n = 20000
+    rng = np.random.RandomState(3)
+    keys = rng.randint(0, 1 << 14, n).astype(np.int32)
+    res = sess.run(bs.Reduce(bs.Const(8, keys, np.ones(n, np.int32)),
+                             lambda a, b: a + b))
+    summary = sess.telemetry_summary()
+    assert summary["skew_flagged_ops"] == []
+    # The boundary was still observed (just not flagged).
+    skews = [e["skew"] for e in summary["ops"].values() if "skew" in e]
+    assert skews and all(s["ratio"] < 2.0 for s in skews)
+    res.discard()
+
+
+def test_mesh_shuffle_skew_recorded_combinerless():
+    """The mesh tier records per-device output counts at partitioned
+    group boundaries; a combiner-less hot-key Reshuffle shows the raw
+    routed skew there."""
+    sess = _mesh_session()
+    n = 1 << 14
+    keys = np.zeros(n, dtype=np.int32)
+    keys[: n // 8] = np.arange(n // 8, dtype=np.int32) % 53 + 1
+    res = sess.run(bs.Reshuffle(bs.Const(8, keys,
+                                         np.ones(n, np.int32))))
+    total = sum(len(f) for f in res.frames())
+    assert total == n
+    summary = sess.telemetry_summary()
+    if sess.executor.device_group_count() == 0:
+        pytest.skip("reshuffle fell back to host tier")
+    assert summary["skew_flagged_ops"], summary["ops"]
+    op = summary["skew_flagged_ops"][0]
+    skew = summary["ops"][op]["skew"]
+    assert skew["rows"][skew["max_shard"]] == max(skew["rows"])
+    res.discard()
+
+
+def test_hub_record_shuffle_accumulates_elementwise():
+    hub = telemetry_mod.TelemetryHub()
+    hub.record_shuffle("op1", 1, [10, 10, 10], [80, 80, 80])
+    hub.record_shuffle("op1", 1, [90, 10, 10], [720, 80, 80])
+    s = hub.summary()
+    skew = s["ops"]["op1"]["skew"]
+    assert skew["rows"] == [100, 20, 20]
+    assert skew["bytes"] == [800, 160, 160]
+    assert skew["max_shard"] == 0
+    assert skew["boundaries"] == 2
+
+
+def test_hub_bounds_op_records():
+    """Iterative drivers mint fresh op names per invocation; the hub
+    evicts oldest ops past MAX_OPS instead of growing forever."""
+    hub = telemetry_mod.TelemetryHub()
+    for i in range(telemetry_mod.MAX_OPS + 50):
+        hub.record_shuffle(f"op{i}", i, [1, 2], [8, 16])
+    assert len(hub._ops) == telemetry_mod.MAX_OPS
+    assert "op0" not in hub._ops  # oldest evicted
+    assert f"op{telemetry_mod.MAX_OPS + 49}" in hub._ops
+
+
+# --------------------------------------------------------- stragglers
+
+class _FakeTask:
+    def __init__(self, op, shard, num_shard=8, inv=1):
+        self.name = TaskName(inv, op, shard, num_shard)
+        self.state_times = {}
+
+
+def test_straggler_flagged_deterministic():
+    """Unit-level: a task 10x slower than its completed siblings' p50
+    is flagged; siblings within the envelope are not."""
+    hub = telemetry_mod.TelemetryHub()
+    now = time.monotonic()
+    for shard in range(6):
+        t = _FakeTask("slowop", shard)
+        slow = shard == 5
+        dur = 1.0 if slow else 0.1
+        t.state_times[TaskState.RUNNING] = now - dur
+        hub(t, TaskState.RUNNING)
+        # Monkeypatch-free determinism: RUNNING stamp is read from
+        # state_times; duration = monotonic() - stamp.
+        hub(t, TaskState.OK)
+    s = hub.summary()
+    rec = s["ops"]["slowop"]
+    assert s["straggler_total"] == 1
+    assert len(rec["stragglers"]) == 1
+    assert rec["stragglers"][0]["shard"] == 5
+    assert rec["stragglers"][0]["duration_s"] > 0.9
+    assert rec["tasks"]["n"] == 6
+
+
+def test_straggler_flagged_end_to_end():
+    """Integration: one sleeping shard in a real session is flagged."""
+    def gen(shard):
+        if shard == 5:
+            time.sleep(0.5)
+        yield ([np.int32(shard)],)
+
+    sess = Session()
+    res = sess.run(bs.ReaderFunc(6, gen, out=[np.int32]))
+    assert len(res.rows()) == 6
+    summary = sess.telemetry_summary()
+    stragglers = [s for e in summary["ops"].values()
+                  for s in e.get("stragglers", ())]
+    assert stragglers, summary["ops"]
+    assert any(s["shard"] == 5 for s in stragglers)
+    res.discard()
+
+
+def test_live_straggler_detection():
+    hub = telemetry_mod.TelemetryHub()
+    now = time.monotonic()
+    for shard in range(5):
+        t = _FakeTask("liveop", shard)
+        t.state_times[TaskState.RUNNING] = now - 0.01
+        hub(t, TaskState.RUNNING)
+        hub(t, TaskState.OK)
+    hung = _FakeTask("liveop", 7)
+    hung.state_times[TaskState.RUNNING] = now - 5.0
+    hub(hung, TaskState.RUNNING)
+    live = hub.live_stragglers()
+    assert len(live) == 1 and live[0]["shard"] == 7
+    # ...and it annotates the status line.
+    lines = hub.status_lines()
+    assert any("straggler" in ln for ln in lines)
+
+
+# ------------------------------------------------------- wave overlap
+
+def test_wave_overlap_accounting_pipelined_vs_serial():
+    """A waved reduce records staging/exposed time; serial staging is
+    100% exposed (efficiency 0), the pipelined efficiency is a valid
+    fraction and the summary carries a session-wide rollup."""
+    from bigslice_tpu.exec.meshexec import MeshExecutor
+    import jax
+    from jax.sharding import Mesh
+
+    n = 1 << 13
+    rng = np.random.RandomState(42)
+    keys = rng.randint(0, 1 << 18, n).astype(np.int32)
+    vals = np.ones(n, np.int32)
+
+    def run(prefetch_depth):
+        mesh = Mesh(np.array(jax.devices()[:8]), ("shards",))
+        sess = Session(executor=MeshExecutor(
+            mesh, prefetch_depth=prefetch_depth))
+        res = sess.run(bs.Reduce(bs.Const(16, keys, vals),
+                                 lambda a, b: a + b))
+        sum(len(f) for f in res.frames())
+        out = sess.telemetry_summary()
+        res.discard()
+        return out
+
+    serial = run(0)
+    waved = [e["waves"] for e in serial["ops"].values()
+             if e.get("waves", {}).get("n_waves", 0) > 1]
+    assert waved, serial["ops"]
+    for w in waved:
+        assert w["staging_s"] >= w["exposed_s"] >= 0
+        assert w["overlap_efficiency"] == 0.0  # serial: all exposed
+    assert serial["overlap_efficiency"] == 0.0
+
+    piped = run(1)
+    waved = [e["waves"] for e in piped["ops"].values()
+             if e.get("waves", {}).get("n_waves", 0) > 1]
+    assert waved, piped["ops"]
+    for w in waved:
+        assert 0.0 <= w["overlap_efficiency"] <= 1.0
+        # abs tolerance: the three fields are rounded independently
+        # to 6 decimals in summary().
+        assert w["hidden_s"] == pytest.approx(
+            w["staging_s"] - w["exposed_s"], abs=5e-6)
+        assert w["compute_s"] > 0
+        # Phase events flowed through on_phase into the hub too.
+        assert w["phases"].get("waveCompute", 0) >= w["n_waves"]
+    assert piped["overlap_efficiency"] is not None
+
+
+# ---------------------------------------------- monitor hardening
+
+def test_raising_monitor_does_not_break_evaluation(capsys):
+    """Satellite: an exception in one monitor must not propagate into
+    the evaluator or the prefetcher thread — logged once, evaluation
+    completes, and later monitors in the chain still run."""
+    calls = []
+
+    class BadMonitor:
+        def __call__(self, task, state):
+            raise RuntimeError("broken monitor")
+
+        def on_phase(self, task, phase, wave):
+            raise RuntimeError("broken phase monitor")
+
+    sess = Session(monitor=BadMonitor())
+    res = sess.run(bs.Const(4, np.arange(8, dtype=np.int32)))
+    assert len(res.rows()) == 8
+    # The chain's later members (status, telemetry) still saw every
+    # transition despite the bad first member.
+    assert sess.telemetry_summary()["task_states"].get("OK") == 4
+    assert "4/4 done" in sess.status.render()
+    err = capsys.readouterr().err
+    assert "monitor" in err and "broken monitor" in err
+    # Logged once (one suppression header), not once per transition.
+    assert err.count("raised (suppressed") == 1
+    res.discard()
+    del calls
+
+
+def test_raising_phase_monitor_does_not_break_waved_run():
+    """The prefetcher thread path: a raising on_phase fires from the
+    staging thread during the overlapped wave pipeline and must not
+    poison staging."""
+    class BadPhase:
+        def __call__(self, task, state):
+            pass
+
+        def on_phase(self, task, phase, wave):
+            raise RuntimeError("phase boom")
+
+    sess = _mesh_session(monitor=BadPhase())
+    n = 1 << 12
+    keys = np.arange(n, dtype=np.int32) % 257
+    res = sess.run(bs.Reduce(bs.Const(16, keys, np.ones(n, np.int32)),
+                             lambda a, b: a + b))
+    assert sum(len(f) for f in res.frames()) == 257
+    res.discard()
+
+
+# ------------------------------------------------- tracer lane reuse
+
+def test_tracer_no_tid_collision_after_rebegin():
+    """Satellite: mixed begin/end interleavings (a re-begun key leaks
+    its old lane) must never hand a fresh begin a tid that is still
+    live — the old len(_tids)+1 derivation did."""
+    from bigslice_tpu.utils.trace import Tracer
+
+    t = Tracer()
+    t.begin("k1", "a")
+    t.begin("k2", "b")
+    t.begin("k1", "a-again")  # re-begin: old k1 lane leaks
+    t.begin("k3", "c")        # must NOT collide with k1's live lane
+    live = list(t._tids.values())
+    assert len(live) == len(set(live)), live
+    t.end("k1")
+    t.end("k2")
+    t.end("k3")
+    # Freed lanes are reused, fresh lanes stay unique.
+    t.begin("k4", "d")
+    t.begin("k5", "e")
+    t.begin("k6", "f")
+    t.begin("k7", "g")
+    live = list(t._tids.values())
+    assert len(live) == len(set(live)), live
+    # Events remain well-formed X events.
+    for e in t.events():
+        assert e["ph"] == "X" and e["dur"] >= 0
+
+
+# -------------------------------------- status printer final snapshot
+
+def test_status_printer_prints_final_snapshot_on_stop():
+    """Satellite: a session shorter than the print interval must not
+    exit with an empty/stale status block — stop() renders once."""
+    from bigslice_tpu.utils.status import Status, StatusPrinter
+
+    stream = io.StringIO()
+    status = Status()
+    printer = StatusPrinter(status, interval=60.0, stream=stream)
+    printer.start()
+    sess = Session(monitor=status)
+    res = sess.run(bs.Const(3, np.arange(6, dtype=np.int32)))
+    assert stream.getvalue() == ""  # interval never elapsed
+    printer.stop()
+    out = stream.getvalue()
+    assert "3/3 done" in out
+    # A second stop with unchanged state does not duplicate the block.
+    printer.stop()
+    assert stream.getvalue() == out
+    res.discard()
+
+
+def test_status_render_carries_skew_annotation():
+    sess = Session()
+    n = 20000
+    keys = np.zeros(n, dtype=np.int32)
+    keys[: n // 10] = np.arange(n // 10, dtype=np.int32) % 97 + 1
+    res = sess.run(bs.Reduce(bs.Const(8, keys, np.ones(n, np.int32)),
+                             lambda a, b: a + b))
+    rendered = sess.status.render()
+    assert "skew" in rendered and "hot shard" in rendered
+    res.discard()
+
+
+# ------------------------------------------------ slicetrace sections
+
+def test_slicetrace_renders_skew_and_overlap_sections(tmp_path, capsys):
+    """Acceptance: tools/slicetrace.py renders the new skew/straggler/
+    overlap sections from a recorded trace."""
+    path = str(tmp_path / "telem.json")
+    sess = _mesh_session(trace_path=path)
+    n = 1 << 13
+    keys = np.arange(n, dtype=np.int32) % 509
+    res = sess.run(bs.Reduce(bs.Const(16, keys, np.ones(n, np.int32)),
+                             lambda a, b: a + b))
+    sum(len(f) for f in res.frames())
+    sess.shutdown()
+    from bigslice_tpu.tools import slicetrace
+
+    assert slicetrace.main([path]) == 0
+    out = capsys.readouterr().out
+    assert ":straggler" in out
+    assert ":overlap" in out and "overlap" in out
+    assert ":skew" in out and "hot" in out
+    # The overlap table carries real staging numbers.
+    assert "stage_ms" in out
+
+
+# ------------------------------------------------------ obsdump tool
+
+def test_obsdump_writes_trace_and_summary(tmp_path):
+    from bigslice_tpu.tools import obsdump
+
+    trace = str(tmp_path / "t.json")
+    summary_path = str(tmp_path / "s.json")
+    assert obsdump.main(["--trace", trace, "--summary", summary_path,
+                         "--rows", "4096"]) == 0
+    with open(trace) as fp:
+        doc = json.load(fp)
+    assert doc["traceEvents"]
+    with open(summary_path) as fp:
+        summary = json.load(fp)
+    assert summary["ops"]
+    assert summary["workload"]["rows"] == 4096
+    assert summary["task_states"].get("OK", 0) > 0
+
+
+# ----------------------------------------------------- summary shape
+
+def test_telemetry_summary_is_json_serializable():
+    sess = Session()
+    res = sess.run(bs.Reduce(
+        bs.Const(4, np.arange(4096, dtype=np.int32) % 97,
+                 np.ones(4096, np.int32)),
+        lambda a, b: a + b))
+    s = sess.telemetry_summary()
+    json.dumps(s)  # must not raise (bench records it into BENCH json)
+    assert "ops" in s and "task_states" in s
+    res.discard()
+
+
+def test_bench_emit_accepts_extra_fields(capsys):
+    import bench
+
+    bench.emit("m", 10.0, "rows/sec", 5.0, overlap_efficiency=0.42)
+    line = json.loads(capsys.readouterr().out)
+    assert line["overlap_efficiency"] == 0.42
+    assert line["vs_baseline"] == 2.0
