@@ -286,6 +286,208 @@ def reduce_wave_bench(keys, vals, num_shards: int, iters: int = 3,
     return len(keys) / best, overlap
 
 
+# ------------------------------------------------------------- staging
+
+def staging_bench(n_rows: int, dim: int = 16, iters: int = 7):
+    """Staging fast-path microbench (one wave's worth of shard I/O):
+    stage N per-shard codec streams into global padded device columns.
+
+    Legacy chain: BSF3 ``np.load`` decode (a copy per column per
+    frame) → ``Frame.concat`` (another copy) → per-shard pad concat +
+    global concat + a ``device_put`` per column. Fast path: BSF4
+    zero-copy view decode → arena two-pass assembly (ONE copy per
+    column, into a reused buffer) → one batched ``device_put``.
+    Same bytes, same result layout; rows/sec per full stage."""
+    import jax
+
+    from bigslice_tpu.exec import staging as staging_mod
+    from bigslice_tpu.frame import codec
+    from bigslice_tpu.frame.frame import Frame
+    from bigslice_tpu.parallel import shuffle as shuffle_mod
+    from bigslice_tpu.parallel.jitutil import bucket_size
+
+    mesh = _mesh()
+    n = mesh.devices.size
+    per = max(1, n_rows // n)
+    frame_rows = 8192
+    rng = np.random.RandomState(13)
+    legacy_blobs, fast_blobs = [], []
+    for s in range(n):
+        keys = rng.randint(0, 4096, per).astype(np.int32)
+        vals = rng.rand(per, dim).astype(np.float32)
+        legacy = fast = b""
+        for i in range(0, per, frame_rows):
+            f = Frame([keys[i : i + frame_rows], vals[i : i + frame_rows]])
+            legacy += codec.encode_frame_v3(f)
+            fast += codec.encode_frame(f)
+        legacy_blobs.append(legacy)
+        fast_blobs.append(fast)
+    nbytes = sum(len(b) for b in fast_blobs)
+    arena = staging_mod.StagingArena(enabled=True)
+
+    def stage_legacy():
+        frames = [Frame.concat(list(codec.read_frames(b)))
+                  for b in legacy_blobs]
+        counts = [len(f) for f in frames]
+        capacity = bucket_size(max(counts + [1]))
+        per_shard_cols = [[f.cols[j] for f in frames]
+                          for j in range(frames[0].num_cols)]
+        cols, cnt = shuffle_mod.shard_columns(
+            mesh, per_shard_cols, counts, capacity
+        )
+        jax.block_until_ready(list(cols) + [cnt])
+
+    arena.mode = staging_mod.staging_mode(mesh)
+    note(f"staging arena mode: {arena.mode}")
+
+    def stage_fast():
+        # Two-pass: header-only scan pins the exact row counts (and so
+        # the bucketed capacity) before any payload bytes move.
+        total = sum(ext.nrows for b in fast_blobs
+                    for ext in codec.scan_frames(b))
+        assert total == n * per
+        lists = [list(codec.read_frames(b)) for b in fast_blobs]
+        host_cols, counts, capacity, bufs = staging_mod.assemble(
+            lists, None, n, arena
+        )
+        cols, cnt = shuffle_mod.place_global_columns(
+            mesh, host_cols, counts
+        )
+        jax.block_until_ready(list(cols) + [cnt])
+        arena.release(bufs)
+
+    out = {}
+    for name, fn in (("legacy", stage_legacy), ("fast", stage_fast)):
+        fn()  # warm (compile nothing; page in)
+        times = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            fn()
+            times.append(time.perf_counter() - t0)
+        best = min(times)
+        out[name] = (n * per) / best
+        note(f"staging[{name}]: {n * per} rows / {nbytes / 1e6:.1f} MB "
+             f"in {best * 1e3:.1f} ms → {out[name]:,.0f} rows/s")
+    return out["fast"], out["legacy"]
+
+
+# ------------------------------------------- reduce-wave, file-staged
+
+def reduce_wave_staged_bench(n_rows: int, dim: int = 16,
+                             rounds: int = 3):
+    """The serving-shape waved Reduce: shard input staged from encoded
+    per-shard stream FILES (doc.go's serverless sharded evaluation —
+    shard I/O must keep up with the device), dense int32 keys so the
+    device lowering is fast and staging is the exposed cost, and a
+    ``dim``-wide float32 vector payload per row (feature/embedding
+    aggregation).
+
+    Measures two configs INTERLEAVED (drift on a shared host must not
+    masquerade as a staging delta), best-of per config:
+
+    - ``legacy``: the PR-2 staging path — BSF3-encoded corpus (np.load
+      decode copies), BIGSLICE_STAGING_ARENA-off executor
+      (Frame.concat + pad-concat + per-column puts).
+    - ``fast``: the shipped defaults — BSF4 zero-copy decode, arena
+      assembly, batched transfer.
+
+    Returns {name: (rows_per_sec, overlap_efficiency, breakdown)}."""
+    import shutil
+    import tempfile
+
+    import bigslice_tpu as bs
+    from bigslice_tpu.exec.meshexec import MeshExecutor
+    from bigslice_tpu.exec.session import Session
+    from bigslice_tpu.frame import codec
+    from bigslice_tpu.frame.frame import Frame
+    from bigslice_tpu.slicetype import ColType, Schema
+
+    mesh = _mesh()
+    S = 4 * max(1, int(mesh.devices.size))
+    per = max(1, n_rows // S)
+    total_rows = S * per
+    schema = Schema([ColType(np.dtype(np.int32), "", ()),
+                     ColType(np.dtype(np.float32), "", (dim,))], 1)
+
+    def corpus(encode, d):
+        rng = np.random.RandomState(17)
+        for s in range(S):
+            keys = rng.randint(0, 4096, per).astype(np.int32)
+            vals = rng.rand(per, dim).astype(np.float32)
+            with open(f"{d}/{s}", "wb") as fp:
+                for i in range(0, per, 8192):
+                    fp.write(encode(Frame([keys[i : i + 8192],
+                                           vals[i : i + 8192]])))
+
+    def reader_for(d):
+        def read_shard(shard):
+            with open(f"{d}/{shard}", "rb") as fp:
+                data = fp.read()
+            yield from codec.read_frames(data)
+
+        return read_shard
+
+    def add(a, b):
+        return a + b
+
+    dirs = []
+    try:
+        sessions = {}
+        for name, encode, arena in (
+                ("legacy", codec.encode_frame_v3, False),
+                ("fast", codec.encode_frame, True)):
+            d = tempfile.mkdtemp(prefix=f"bs-stagebench-{name}-")
+            dirs.append(d)
+            corpus(encode, d)
+            sessions[name] = (
+                Session(executor=MeshExecutor(
+                    mesh, prefetch_depth=1, staging_arena=arena
+                )),
+                reader_for(d),
+            )
+
+        def run_once(name):
+            sess, read_shard = sessions[name]
+            r = bs.Reduce(bs.ReaderFunc(S, read_shard, out=schema), add)
+            res = sess.run(r)
+            total = 0
+            for f in res.frames():
+                total += len(f)
+            res.discard()
+            return total
+
+        distinct = {name: run_once(name) for name in sessions}  # warm
+        best = {name: float("inf") for name in sessions}
+        for _ in range(rounds):
+            for name in sessions:
+                t0 = time.perf_counter()
+                run_once(name)
+                best[name] = min(best[name],
+                                 time.perf_counter() - t0)
+        out = {}
+        for name, (sess, _) in sessions.items():
+            if sess.executor.device_group_count() == 0:
+                raise RuntimeError(
+                    "staged wave reduce never engaged the device path"
+                )
+            summary = sess.telemetry_summary()
+            overlap = summary.get("overlap_efficiency")
+            breakdown = {}
+            for entry in summary["ops"].values():
+                for k, v in entry.get("waves", {}).get(
+                        "staging_breakdown", {}).items():
+                    breakdown[k] = round(breakdown.get(k, 0.0) + v, 6)
+            note(f"reduce_wave_staged[{name}]: {distinct[name]} keys, "
+                 f"{S} file shards x {per} rows (payload dim {dim}), "
+                 f"best {best[name] * 1e3:.0f} ms, overlap {overlap}, "
+                 f"breakdown {breakdown}")
+            out[name] = (total_rows / best[name], overlap, breakdown)
+        return out
+    finally:
+        for d in dirs:
+            shutil.rmtree(d, ignore_errors=True)
+
+
 # ------------------------------------------------------------------ join
 
 def join_key_space(n_rows: int) -> int:
@@ -814,6 +1016,33 @@ def run_mode(mode: str, size, fallback: bool) -> None:
         emit("reduce_wave_e2e_rows_per_sec", piped, "rows/sec", serial,
              overlap_efficiency=piped_overlap,
              serial_overlap_efficiency=serial_overlap)
+    elif mode == "reduce-wave-staged":
+        # The serving shape: waved Reduce whose shards stage from
+        # encoded stream files (read → decode → assemble → upload is
+        # the exposed cost; dense keys keep the device side fast).
+        # vs_baseline is the PR-2 staging path (BSF3 decode copies,
+        # concat+pad staging, per-column puts) on the same corpus
+        # shape, interleaved on the same host — the number that judges
+        # the staging fast path e2e.
+        n_rows = size or (1 << 22 if fallback else 1 << 24)
+        results = reduce_wave_staged_bench(n_rows)
+        legacy, legacy_overlap, legacy_bd = results["legacy"]
+        fastv, fast_overlap, fast_bd = results["fast"]
+        note(f"reduce_wave_staged: legacy {legacy:,.0f} rows/s, fast "
+             f"{fastv:,.0f} rows/s → {fastv / legacy:.2f}x")
+        emit("reduce_wave_staged_e2e_rows_per_sec", fastv, "rows/sec",
+             legacy,
+             overlap_efficiency=fast_overlap,
+             staging_breakdown=fast_bd,
+             legacy_overlap_efficiency=legacy_overlap,
+             legacy_staging_breakdown=legacy_bd)
+    elif mode == "staging":
+        # Host-staging microbench: the BSF4 + arena + batched-put fast
+        # path vs the BSF3 + concat + per-column-put legacy chain, on
+        # one wave's worth of per-shard streams.
+        n_rows = size or (1 << 19 if fallback else 1 << 22)
+        fastv, legacy = staging_bench(n_rows)
+        emit("staging_rows_per_sec", fastv, "rows/sec", legacy)
     elif mode == "reduce-kernel":
         n_rows = size or (1 << 21 if fallback else 1 << 24)
         rng = np.random.RandomState(42)
@@ -875,7 +1104,8 @@ def run_mode(mode: str, size, fallback: bool) -> None:
 # Matrix order: the honest e2e reduce headline runs LAST because the
 # driver parses the tail JSON line (VERDICT r2 #1). Fast sizes so the
 # full sweep stays bounded even on the 1-vCPU fallback.
-MATRIX = ("reduce-sort", "reduce-dense", "reduce-wave", "join",
+MATRIX = ("reduce-sort", "reduce-dense", "reduce-wave", "staging",
+          "reduce-wave-staged", "join",
           "join-dense", "wordcount", "sortshuffle", "cogroup",
           "kmeans", "attention", "reduce")
 
@@ -885,6 +1115,8 @@ _MATRIX_SIZES = {
     "reduce-sort": 1 << 20,
     "reduce-dense": 1 << 20,
     "reduce-wave": 1 << 20,
+    "staging": 1 << 19,
+    "reduce-wave-staged": 1 << 19,
     "join": 1 << 17,
     "join-dense": 1 << 17,
     "wordcount": 1 << 17,
@@ -935,7 +1167,8 @@ def main():
     fallback = backend in ("cpu", "cpu-fallback")
     args = sys.argv[1:]
     known = ("reduce", "reduce-sort", "reduce-nohash", "reduce-dense",
-             "reduce-wave", "reduce-kernel", "join", "join-dense",
+             "reduce-wave", "reduce-wave-staged", "staging",
+             "reduce-kernel", "join", "join-dense",
              "join-kernel", "wordcount", "sortshuffle", "cogroup",
              "kmeans", "attention", "matrix")
     mode = "matrix"

@@ -39,7 +39,9 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from bigslice_tpu import sliceio
+from bigslice_tpu.frame import codec as codec_mod
 from bigslice_tpu.frame.frame import Frame
+from bigslice_tpu.exec import staging as staging_mod
 from bigslice_tpu.exec import store as store_mod
 from bigslice_tpu.exec.evaluate import (
     PHASE_WAVE_COMPUTE,
@@ -61,6 +63,13 @@ from bigslice_tpu.parallel import shuffle as shuffle_mod
 # group (other shards already OK from a prior run), run the stragglers on
 # the fallback executor rather than waiting forever.
 GROUP_WAIT_SECS = 0.25
+
+
+def _stat_add(stats, key: str, dt: float) -> None:
+    """Accumulate one staging-breakdown component (stats is None on
+    paths nobody observes — retries, restages)."""
+    if stats is not None:
+        stats[key] = stats.get(key, 0.0) + dt
 
 # How long a store-bridge reader waits for a queued (dispatcher-ordered)
 # late gather of a mesh-resident output before judging it failed.
@@ -435,7 +444,8 @@ class MeshExecutor:
                  hash_aggregate: Optional[bool] = None,
                  prefetch_depth: Optional[int] = None,
                  donate_buffers: Optional[bool] = None,
-                 subid_split: Optional[bool] = None):
+                 subid_split: Optional[bool] = None,
+                 staging_arena: Optional[bool] = None):
         import os
 
         self.mesh = mesh
@@ -482,6 +492,21 @@ class MeshExecutor:
             subid_split = env not in ("0", "false", "off") if env \
                 else True
         self.subid_split = bool(subid_split)
+        # Staging fast path (exec/staging.py): per-(schema, capacity)
+        # reusable host arena + two-pass assembly replaces the
+        # decode-copy → Frame.concat → pad-concat chain with one copy
+        # per column into a recycled buffer, uploaded as one batched
+        # device_put per dep. Chicken bit (BIGSLICE_STAGING_ARENA=0 or
+        # staging_arena=False) = the pre-arena path, for A/B and the
+        # bit-identical parity test.
+        self.staging_arena = staging_mod.StagingArena(
+            enabled=staging_arena
+        )
+        self.stage_threads = staging_mod.stage_threads_default()
+        # Per-thread staging context (declared schema + breakdown stats
+        # for the _upload seam, which keeps its 1-arg signature so
+        # test spies wrapping it stay valid).
+        self._stage_tls = threading.local()
         # Per-device working-set budget for one compiled group program
         # (HBM-overflow splitting, round-2 verdict #6): a wave whose
         # estimated buffers exceed it runs as K row-slices whose
@@ -925,6 +950,7 @@ class MeshExecutor:
                 "hash_off": sorted(self._hash_off),
                 "cogroup_caps": dict(self._cogroup_caps),
                 "device_groups": len(self._outputs),
+                "staging_arena": self.staging_arena.stats(),
             }
         resident = 0
         for o in outs:
@@ -1461,17 +1487,21 @@ class MeshExecutor:
         return getattr(sess, "telemetry", None)
 
     def _telemetry_staging(self, task0: Task, wave: int, dur_s: float,
-                           exposed_s: float) -> None:
-        """One wave's input staging time and the portion of it the
+                           exposed_s: float,
+                           breakdown: Optional[dict] = None) -> None:
+        """One wave's input staging time, the portion of it the
         compute thread actually waited on (== dur_s on serial paths;
-        the staged.get() wait on the pipelined path)."""
+        the staged.get() wait on the pipelined path), and the
+        read/decode/assemble/upload breakdown of where staging time
+        went (the *why* behind overlap_efficiency)."""
         hub = self._telemetry_hub()
         if hub is None:
             return
         try:
             hub.record_wave_staging(task0.name.op,
                                     task0.name.inv_index,
-                                    wave, dur_s, exposed_s)
+                                    wave, dur_s, exposed_s,
+                                    breakdown=breakdown)
         except Exception:
             pass
 
@@ -1534,11 +1564,12 @@ class MeshExecutor:
         the overlapped pipeline. Wave 0's inputs stage inline either
         way: the budget-aware depth decision needs their size."""
         t0 = time.perf_counter()
-        inputs0 = self._group_inputs(wave_tasks[0], 0)
+        stats0: dict = {}
+        inputs0 = self._group_inputs(wave_tasks[0], 0, stats=stats0)
         stage0 = time.perf_counter() - t0
         # Wave 0 staging is exposed by construction (nothing computes
         # yet for prefetch to hide behind).
-        self._telemetry_staging(task0, 0, stage0, stage0)
+        self._telemetry_staging(task0, 0, stage0, stage0, stats0)
         depth = self._effective_prefetch_depth(task0, inputs0,
                                                len(wave_tasks))
         if depth == 0:
@@ -1585,11 +1616,13 @@ class MeshExecutor:
                     t0 = time.perf_counter()
                     self._hint_store_prefetch(wave_tasks, w + 1,
                                               w + 1 + depth)
-                    item = (self._group_inputs(wave_tasks[w], w), None,
-                            time.perf_counter() - t0)
+                    wstats: dict = {}
+                    item = (self._group_inputs(wave_tasks[w], w,
+                                               stats=wstats), None,
+                            time.perf_counter() - t0, wstats)
                     self._emit_phase(task0, PHASE_WAVE_PREFETCH, w)
                 except BaseException as e:  # noqa: BLE001 — re-raised
-                    item = (None, e, 0.0)  # in wave order on the main
+                    item = (None, e, 0.0, None)  # in wave order on the
                 while not stop.is_set():   # thread
                     try:
                         staged.put(item, timeout=0.1)
@@ -1633,7 +1666,7 @@ class MeshExecutor:
                     inputs = inputs0
                 else:
                     t0 = time.perf_counter()
-                    inputs, err, stage_dur = staged.get()
+                    inputs, err, stage_dur, wstats = staged.get()
                     wait = time.perf_counter() - t0
                     if err is not None:
                         raise err
@@ -1641,7 +1674,8 @@ class MeshExecutor:
                     # this thread actually sat waiting on. Hidden =
                     # stage_dur - exposed is the pipeline's win.
                     self._telemetry_staging(task0, w, stage_dur,
-                                            min(wait, stage_dur))
+                                            min(wait, stage_dur),
+                                            wstats)
                 self._emit_phase(task0, PHASE_WAVE_COMPUTE, w)
                 inflight.append(
                     (self._dispatch_wave(wave_tasks[w], w, inputs), w,
@@ -1712,10 +1746,11 @@ class MeshExecutor:
         task0 = tasks[0]
         if inputs is None:
             t0 = time.perf_counter()
-            inputs = self._group_inputs(tasks, wave)
+            wstats: dict = {}
+            inputs = self._group_inputs(tasks, wave, stats=wstats)
             dur = time.perf_counter() - t0
             # Serial staging: fully exposed (nothing overlapped it).
-            self._telemetry_staging(task0, wave, dur, dur)
+            self._telemetry_staging(task0, wave, dur, dur, wstats)
         t_run = time.perf_counter()
         self._maybe_auto_dense(task0, inputs, wave)
         budget = self.device_budget_bytes
@@ -2292,7 +2327,8 @@ class MeshExecutor:
                 self._programs.pop(next(iter(self._programs)))
         return prog
 
-    def _group_inputs(self, tasks: List[Task], wave: int = 0):
+    def _group_inputs(self, tasks: List[Task], wave: int = 0,
+                      stats: Optional[dict] = None):
         """Build [(global cols, counts, capacity, has_subid, owned)] —
         one entry per dep (or one host-source upload for dependency-less
         chains). ``owned`` marks inputs this call staged itself (fresh
@@ -2300,21 +2336,43 @@ class MeshExecutor:
         opposed to zero-copy references into live producer outputs.
         Called from the wave-pipeline prefetcher thread as well as the
         group thread: staging is read-only against executor state plus
-        local device_put, never a collective."""
+        local device_put, never a collective. ``stats`` (optional)
+        accumulates the read/decode/assemble/upload breakdown the
+        telemetry hub records per staged wave."""
         task0 = tasks[0]
         if not task0.deps:
-            # Host source: run each shard's reader, upload.
-            return [self._upload(
-                [sliceio.read_all(
-                    t.chain[-1].reader(t.name.shard, []),
-                    t.chain[-1].schema,
-                ).to_host() for t in tasks]
-            )]
-        return [self._dep_input(tasks, i, wave)
+            # Host source: drain each shard's reader (inline — user
+            # reader thread-safety is not assumed), then fast-assemble.
+            schema = task0.chain[-1].schema
+            t0 = time.perf_counter()
+            with codec_mod.decode_clock() as ck:
+                shard_lists = [
+                    [f.to_host()
+                     for f in t.chain[-1].reader(t.name.shard, [])
+                     if len(f)]
+                    for t in tasks
+                ]
+            _stat_add(stats, "decode_s", ck.seconds)
+            _stat_add(stats, "read_s",
+                      time.perf_counter() - t0 - ck.seconds)
+            return [self._stage_upload(shard_lists, schema, stats)]
+        return [self._dep_input(tasks, i, wave, stats)
                 for i in range(len(task0.deps))]
 
+    def _stage_upload(self, shard_lists, schema, stats: Optional[dict]):
+        """Stage per-shard frame lists through the ``_upload`` seam,
+        handing it the declared schema and the stats sink via the
+        staging thread-local (the seam keeps its 1-arg signature — test
+        spies wrap it)."""
+        tls = self._stage_tls
+        tls.schema, tls.stats = schema, stats
+        try:
+            return self._upload(shard_lists)
+        finally:
+            tls.schema = tls.stats = None
+
     def _dep_input(self, tasks: List[Task], dep_idx: int,
-                   wave: int = 0):
+                   wave: int = 0, stats: Optional[dict] = None):
         """(global cols, counts, capacity, has_subid, owned) for one
         dep; owned=False for zero-copy device-resident chaining."""
         task0 = tasks[0]
@@ -2405,39 +2463,114 @@ class MeshExecutor:
                     )
                 return self._upload(per_shard)
         # Fallback-produced dep: load frames from the store per shard.
-        per_shard_frames = []
-        for t in tasks:
+        # Per-shard reads fan out on the small staging pool so store
+        # latency for different shards overlaps (disk/GCS reads are
+        # independent); the decode clock splits read vs decode time.
+        def read_shard(t):
             dep = t.deps[dep_idx]
             frames = []
-            for p in dep.tasks:
-                try:
-                    frames.extend(self.store.read(p.name, dep.partition))
-                except store_mod.Missing as e:
-                    raise DepLost(p) from e
-            schema = dep.tasks[0].schema
-            per_shard_frames.append(
-                Frame.concat(frames).to_host() if frames
-                else Frame.empty(schema)
-            )
-        return self._upload(per_shard_frames)
+            with codec_mod.decode_clock() as ck:
+                for p in dep.tasks:
+                    try:
+                        frames.extend(
+                            self.store.read(p.name, dep.partition)
+                        )
+                    except store_mod.Missing as e:
+                        raise DepLost(p) from e
+            return frames, ck.seconds
 
-    def _upload(self, per_shard_frames: List[Frame]):
+        t0 = time.perf_counter()
+        results = staging_mod.map_shards(read_shard, tasks,
+                                         self.stage_threads)
+        elapsed = time.perf_counter() - t0
+        # Per-worker decode clocks sum CPU-ish time across overlapped
+        # pool threads; cap at the wall elapsed so the breakdown stays
+        # in wall-clock units (components never exceed the stage).
+        decode_s = min(sum(r[1] for r in results), elapsed)
+        _stat_add(stats, "decode_s", decode_s)
+        _stat_add(stats, "read_s", max(0.0, elapsed - decode_s))
+        schema = tasks[0].deps[dep_idx].tasks[0].schema
+        return self._stage_upload([r[0] for r in results], schema,
+                                  stats)
+
+    def _upload(self, per_shard_frames):
+        """Stage per-shard host data onto the mesh: (global cols,
+        counts, capacity, False, owned=True). Accepts one Frame per
+        shard (legacy callers) or one LIST of frames per shard (the
+        staging paths — assembled without a ``Frame.concat``
+        intermediate). The fast path assembles into reusable arena
+        buffers and issues one batched device_put; the legacy
+        concat+pad path remains for object columns, dtype drift, and
+        the BIGSLICE_STAGING_ARENA=0 chicken bit."""
+        tls = self._stage_tls
+        schema = getattr(tls, "schema", None)
+        stats = getattr(tls, "stats", None)
+        shard_lists = [
+            [f] if isinstance(f, Frame) else list(f)
+            for f in per_shard_frames
+        ]
+        if self.staging_arena.enabled:
+            if self.staging_arena.mode is None:
+                self.staging_arena.mode = staging_mod.staging_mode(
+                    self.mesh
+                )
+            t0 = time.perf_counter()
+            try:
+                host_cols, counts, capacity, bufs = staging_mod.assemble(
+                    shard_lists, schema, self.nmesh, self.staging_arena
+                )
+            except staging_mod.StagingFallback:
+                pass
+            else:
+                _stat_add(stats, "assemble_s",
+                          time.perf_counter() - t0)
+                t1 = time.perf_counter()
+                cols, counts_arr = shuffle_mod.place_global_columns(
+                    self.mesh, host_cols, counts
+                )
+                if self.staging_arena.mode == "recycle":
+                    # The transfer detaches from the host buffers
+                    # (probed): settle it, then recycle the arena slots
+                    # for the next wave (donated waves recycle the same
+                    # way — donation consumes the DEVICE buffers, the
+                    # host slot is ours). In zerocopy mode the device
+                    # arrays own the buffers for life and nothing
+                    # blocks here.
+                    import jax
+
+                    jax.block_until_ready(list(cols) + [counts_arr])
+                    self.staging_arena.release(bufs)
+                _stat_add(stats, "upload_s", time.perf_counter() - t1)
+                # owned=True: placed for this wave alone — nothing else
+                # holds them, so the wave program may donate them.
+                return cols, counts_arr, capacity, False, True
+        # Legacy path: concat per shard, pad, per-column placement.
+        t0 = time.perf_counter()
+        if schema is None:
+            first = next((f for fl in shard_lists for f in fl), None)
+            if first is None:
+                raise ValueError("upload of zero frames with no schema")
+            schema = first.schema
+        frames = [
+            Frame.concat(fl).to_host() if fl else Frame.empty(schema)
+            for fl in shard_lists
+        ]
         # Padded-mesh groups (S < N shards): trailing devices carry
         # empty shards.
-        per_shard_frames = list(per_shard_frames)
-        while len(per_shard_frames) < self.nmesh:
-            per_shard_frames.append(
-                Frame.empty(per_shard_frames[0].schema)
-            )
-        counts = [len(f) for f in per_shard_frames]
-        ncols = per_shard_frames[0].num_cols
+        while len(frames) < self.nmesh:
+            frames.append(Frame.empty(frames[0].schema))
+        counts = [len(f) for f in frames]
+        ncols = frames[0].num_cols
         per_shard_cols = [
-            [f.cols[j] for f in per_shard_frames] for j in range(ncols)
+            [f.cols[j] for f in frames] for j in range(ncols)
         ]
         capacity = bucket_size(max(counts + [1]))
+        _stat_add(stats, "assemble_s", time.perf_counter() - t0)
+        t1 = time.perf_counter()
         cols, counts_arr = shuffle_mod.shard_columns(
             self.mesh, per_shard_cols, counts, capacity
         )
+        _stat_add(stats, "upload_s", time.perf_counter() - t1)
         # owned=True: these arrays were placed for this wave alone —
         # nothing else holds them, so the wave program may donate them.
         return cols, counts_arr, capacity, False, True
@@ -2511,6 +2644,16 @@ class MeshExecutor:
             return None
         for ct in schema.key:
             if ct.dtype == np.dtype(object) or ct.shape:
+                return None
+            if np.dtype(ct.dtype).kind == "f":
+                # Float keys diverge under the hash lowering: the claim
+                # cascade slot-hashes key BIT PATTERNS but compares
+                # with ==, so -0.0 and 0.0 claim separate slots (two
+                # output rows where the sort lowering merges them) and
+                # a NaN key can never match its own claimed slot
+                # (burns every cascade round, then blacklists the op).
+                # Float keys gain little from the hash path — route
+                # them to the sort lowering, which follows IEEE ==.
                 return None
         from bigslice_tpu.parallel.dense import classified_ops_cached
 

@@ -200,6 +200,8 @@ class Frame:
     # -- host/device movement --------------------------------------------
 
     def to_host(self) -> "Frame":
+        if all(isinstance(c, np.ndarray) for c in self.cols):
+            return self  # immutable; already host-resident
         return Frame([_as_host(c) for c in self.cols], self.schema)
 
     def device_cols(self) -> List[Any]:

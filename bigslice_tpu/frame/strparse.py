@@ -232,7 +232,19 @@ _POOL_LOCK = threading.Lock()  # created at import: the lazy-creation
 
 def parse_procs() -> int:
     """Worker count for the parse pool (0/1 → no pool). Overridable via
-    BIGSLICE_PARSE_PROCS for benchmarking and tests."""
+    BIGSLICE_PARSE_PROCS for benchmarking and tests.
+
+    NOTE the spawn-context contract that comes with the pool: spawn
+    workers re-import the driver's ``__main__`` module, so a driver run
+    as ``python driver.py`` MUST guard its pipeline behind
+    ``if __name__ == "__main__":`` — an unguarded script would
+    re-execute its whole pipeline inside every worker during spawn
+    prepare. (``python -m bigslice_tpu.tools.run`` entries are safe;
+    plain scripts need the guard.) Set ``BIGSLICE_PARSE_PROCS=0`` to
+    keep parsing single-process. ``_pool()`` additionally refuses to
+    build a pool inside a process that is itself a multiprocessing
+    worker, so even an unguarded script cannot recurse into a process
+    explosion."""
     env = os.environ.get("BIGSLICE_PARSE_PROCS")
     if env:
         return max(0, int(env))
@@ -248,6 +260,13 @@ def _pool():
     amortized across the corpus). The pool is terminated at interpreter
     exit and whenever the proc count changes."""
     global _POOL, _POOL_PROCS
+    import multiprocessing as _mp
+
+    if _mp.parent_process() is not None:
+        # This process IS a multiprocessing worker (e.g. a spawn worker
+        # re-importing an unguarded driver __main__): a nested pool
+        # here recurses into a process explosion. Parse inline.
+        return None
     procs = parse_procs()
     if procs < 2:
         return None

@@ -51,7 +51,7 @@ class ShardCache:
         try:
             with fileio.open_read(path) as fp:
                 head = fp.read(4)
-                return head in (b"", codec.MAGIC, codec.ZMAGIC)
+                return head in (b"", codec.ZMAGIC) + codec.MAGICS
         except (OSError, FileNotFoundError):
             return False
 

@@ -639,33 +639,8 @@ def shard_columns(mesh, cols: Sequence[np.ndarray], counts: Sequence[int],
     Returns (global_cols, global_counts) ready for MeshShuffle /
     MeshReduceByKey.
     """
-    import jax
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
     nshards = mesh.devices.size
-    # Shard axis 0 over EVERY mesh axis: 1-D meshes get the usual
-    # P("shards"); 2-D (dcn, ici) meshes get P(("dcn","ici")) — shard
-    # s lives on mesh.devices.flat[s] (row-major) either way, so the
-    # flat and hierarchical shuffles see identical placements.
-    sharding = NamedSharding(mesh, P(tuple(mesh.axis_names)))
-    multi = is_multiprocess_mesh(mesh)
-    if multi:
-        pid = jax.process_index()
-        local = [i for i, d in enumerate(mesh.devices.flat)
-                 if d.process_index == pid]
-
-    def place(glob):
-        if not multi:
-            return jax.device_put(glob, sharding)
-        rows_per = glob.shape[0] // nshards
-        local_rows = np.concatenate([
-            glob[i * rows_per : (i + 1) * rows_per] for i in local
-        ])
-        return jax.make_array_from_process_local_data(
-            sharding, local_rows, glob.shape
-        )
-
-    out = []
+    globs = []
     for per_shard in cols:
         assert len(per_shard) == nshards
         padded = []
@@ -679,9 +654,47 @@ def shard_columns(mesh, cols: Sequence[np.ndarray], counts: Sequence[int],
             pad = np.zeros((capacity - len(chunk),) + chunk.shape[1:],
                            chunk.dtype)
             padded.append(np.concatenate([chunk, pad]))
-        out.append(place(np.concatenate(padded)))
-    counts_arr = place(np.asarray(counts, np.int32))
-    return out, counts_arr
+        globs.append(np.concatenate(padded))
+    return place_global_columns(mesh, globs, counts)
+
+
+def place_global_columns(mesh, globs: Sequence[np.ndarray], counts):
+    """Place already-assembled global padded column arrays (shard s's
+    rows at ``[s*capacity, (s+1)*capacity)``) onto the mesh, plus the
+    per-shard counts vector — ONE batched ``jax.device_put`` with an
+    explicit sharding on single-process meshes (the transfer engine
+    sees the whole wave at once, instead of a put per column), the
+    process-local-rows construction on multi-process meshes.
+
+    The staging arena (exec/staging.py) assembles directly into this
+    layout; ``shard_columns`` feeds it from per-shard chunks."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    nshards = mesh.devices.size
+    # Shard axis 0 over EVERY mesh axis: 1-D meshes get the usual
+    # P("shards"); 2-D (dcn, ici) meshes get P(("dcn","ici")) — shard
+    # s lives on mesh.devices.flat[s] (row-major) either way, so the
+    # flat and hierarchical shuffles see identical placements.
+    sharding = NamedSharding(mesh, P(tuple(mesh.axis_names)))
+    counts_host = np.asarray(counts, np.int32)
+    if not is_multiprocess_mesh(mesh):
+        placed = jax.device_put(list(globs) + [counts_host], sharding)
+        return placed[:-1], placed[-1]
+    pid = jax.process_index()
+    local = [i for i, d in enumerate(mesh.devices.flat)
+             if d.process_index == pid]
+
+    def place(glob):
+        rows_per = glob.shape[0] // nshards
+        local_rows = np.concatenate([
+            glob[i * rows_per : (i + 1) * rows_per] for i in local
+        ])
+        return jax.make_array_from_process_local_data(
+            sharding, local_rows, glob.shape
+        )
+
+    return [place(g) for g in globs], place(counts_host)
 
 
 def unshard_columns(cols: Sequence, counts, capacity: int) -> List[List[np.ndarray]]:

@@ -22,7 +22,11 @@ plus the telemetry-hub sections (utils/telemetry.py):
 - ``invN:overlap`` — per-op wave-pipeline accounting: staging time,
   the compute-exposed part, the prefetch-hidden part, and the overlap
   efficiency percentage (from ``bigslice:waveStaging`` /
-  ``bigslice:waveRun`` instants).
+  ``bigslice:waveRun`` instants);
+- ``invN:staging`` — the staging-breakdown companion: per op, where
+  staging time went (read / decode / assemble / upload — the staging
+  fast path's stages, exec/staging.py). Rendered only for traces whose
+  staging instants carry the breakdown fields.
 
 Traces from older sessions (no ``inv`` task args) fall back to one
 flat all-ops quartile table.
@@ -158,18 +162,31 @@ def _print_skew(out: List[str], inv, events):
         )
 
 
+# Staging-breakdown phases a waveStaging instant may carry — derived
+# from the hub's single source of truth (telemetry emits each "<k>_s"
+# accumulator as a "<k>_ms" instant field).
+from bigslice_tpu.utils.telemetry import TelemetryHub
+
+STAGE_PHASES = tuple(k[:-2] + "_ms" for k in TelemetryHub.STAGE_PHASES)
+
+
 def _print_overlap(out: List[str], inv, staging, runs):
     """Per-op wave-pipeline accounting from bigslice:waveStaging /
-    bigslice:waveRun instants: how much staging the prefetcher hid."""
+    bigslice:waveRun instants: how much staging the prefetcher hid,
+    and (when the staging fast path recorded it) WHERE the staging
+    time went — the read/decode/assemble/upload breakdown."""
     agg: Dict[str, dict] = {}
     for ev in staging:
         a = ev.get("args", {})
         d = agg.setdefault(a.get("op", "?"), {
             "waves": 0, "ms": 0.0, "exposed_ms": 0.0, "compute_ms": 0.0,
+            **{p: 0.0 for p in STAGE_PHASES},
         })
         d["waves"] += 1
         d["ms"] += a.get("ms", 0.0)
         d["exposed_ms"] += a.get("exposed_ms", 0.0)
+        for p in STAGE_PHASES:
+            d[p] += a.get(p, 0.0) or 0.0
     for ev in runs:
         a = ev.get("args", {})
         if a.get("op") in agg:
@@ -187,6 +204,20 @@ def _print_overlap(out: List[str], inv, staging, runs):
             f"  {op[:28]:<28} {d['waves']:>5} {d['ms']:>9.2f} "
             f"{d['exposed_ms']:>9.2f} {hidden:>9.2f} "
             f"{d['compute_ms']:>9.2f} {eff:>7.1%}"
+        )
+    if not any(any(d[p] for p in STAGE_PHASES)
+               for d in agg.values()):
+        return  # pre-fast-path trace: no breakdown to render
+    out.append(f"# inv{inv}:staging (where staging time went)")
+    out.append(f"  {'op':<28} {'read_ms':>9} {'decode_ms':>10} "
+               f"{'assemb_ms':>10} {'upload_ms':>10}")
+    for op, d in sorted(agg.items()):
+        if not any(d[p] for p in STAGE_PHASES):
+            continue
+        out.append(
+            f"  {op[:28]:<28} {d['read_ms']:>9.2f} "
+            f"{d['decode_ms']:>10.2f} {d['assemble_ms']:>10.2f} "
+            f"{d['upload_ms']:>10.2f}"
         )
 
 

@@ -28,7 +28,10 @@ computes three actionable signal families:
    vs. the portion the compute thread actually *waited* on it
    (exposed). ``hidden / total`` is the pipeline's overlap-efficiency:
    1.0 means prefetch fully hid staging behind compute, 0.0 is the
-   serial executor.
+   serial executor. The staging record also carries a
+   read/decode/assemble/upload breakdown (the staging fast path's
+   stages, exec/staging.py), so a low overlap number comes with the
+   *why*: which stage of staging the time went to.
 
 Surfaced three ways: ``prometheus_text()`` (the ``/debug/metrics``
 endpoint of utils/debughttp.py), ``status_lines()`` (live skew /
@@ -116,6 +119,10 @@ class _OpRecord:
         self.staged_waves = 0
         self.max_wave = -1
         self.phase_counts: Dict[str, int] = {}
+        # staging breakdown: where staging time went (the *why* behind
+        # overlap_efficiency) — read (store/reader drain), decode
+        # (codec), assemble (arena copy+pad), upload (device_put).
+        self.stage_phases: Dict[str, float] = {}
 
 
 class TelemetryHub:
@@ -282,23 +289,41 @@ class TelemetryHub:
         ratio = mx / max(median, 1.0)
         return ratio, max_shard, median, total
 
+    # The staging-breakdown phases an executor may report (the staging
+    # fast path's read → decode → assemble → upload chain); unknown
+    # keys are dropped so a buggy caller can't grow the record.
+    STAGE_PHASES = ("read_s", "decode_s", "assemble_s", "upload_s")
+
     def record_wave_staging(self, op: str, inv: Optional[int],
                             wave: int, dur_s: float,
-                            exposed_s: float) -> None:
-        """One wave's input staging: total duration, and the portion the
+                            exposed_s: float,
+                            breakdown: Optional[dict] = None) -> None:
+        """One wave's input staging: total duration, the portion the
         compute thread actually blocked on (== dur_s on the serial
-        path; the wait in ``staged.get()`` on the pipelined path)."""
+        path; the wait in ``staged.get()`` on the pipelined path), and
+        optionally the read/decode/assemble/upload breakdown of where
+        the staging time went."""
         dur_s = max(0.0, float(dur_s))
         exposed_s = min(max(0.0, float(exposed_s)), dur_s)
+        clean: Dict[str, float] = {}
+        if breakdown:
+            for k in self.STAGE_PHASES:
+                v = breakdown.get(k)
+                if v:
+                    clean[k] = max(0.0, float(v))
         with self._lock:
             rec = self._op(op, inv)
             rec.staging_s += dur_s
             rec.exposed_s += exposed_s
             rec.staged_waves += 1
             rec.max_wave = max(rec.max_wave, int(wave))
+            for k, v in clean.items():
+                rec.stage_phases[k] = rec.stage_phases.get(k, 0.0) + v
         self._emit("bigslice:waveStaging", op=op, inv=inv, wave=wave,
                    ms=round(dur_s * 1e3, 3),
-                   exposed_ms=round(exposed_s * 1e3, 3))
+                   exposed_ms=round(exposed_s * 1e3, 3),
+                   **{k[:-2] + "_ms": round(v * 1e3, 3)
+                      for k, v in clean.items()})
 
     def record_wave_compute(self, op: str, inv: Optional[int],
                             wave: int, dur_s: float) -> None:
@@ -390,6 +415,11 @@ class TelemetryHub:
                         "overlap_efficiency": round(eff, 4),
                         "phases": dict(rec.phase_counts),
                     }
+                    if rec.stage_phases:
+                        entry["waves"]["staging_breakdown"] = {
+                            k: round(v, 6)
+                            for k, v in rec.stage_phases.items()
+                        }
                     total_staging += rec.staging_s
                     total_hidden += hidden
                 ops[op] = entry
@@ -552,6 +582,15 @@ class TelemetryHub:
                     line("bigslice_wave_staging_seconds_total",
                          {"op": op, "kind": "hidden"},
                          f"{max(0.0, rec.staging_s - rec.exposed_s):.6f}")
+
+            metric("bigslice_wave_staging_phase_seconds_total",
+                   "Cumulative wave staging time by phase "
+                   "(read/decode/assemble/upload — why staging is "
+                   "slow).", "counter")
+            for op, rec in ops.items():
+                for ph, v in sorted(rec.stage_phases.items()):
+                    line("bigslice_wave_staging_phase_seconds_total",
+                         {"op": op, "phase": ph[:-2]}, f"{v:.6f}")
 
             metric("bigslice_wave_compute_seconds_total",
                    "Cumulative wave compute (dispatch to settle) time.",

@@ -381,3 +381,56 @@ def test_e2e_join_hash_path_matches_local():
     cb = collections.Counter(bk.tolist())
     expect = {k: (ca[k], cb[k]) for k in ca if k in cb}
     assert got == expect
+
+
+def test_float_keys_route_to_sort_lowering():
+    """Float keys never take the hash lowering (ADVICE r5): the claim
+    cascade slot-hashes bit patterns but compares with ==, so -0.0/0.0
+    would claim separate slots and NaN keys could never match their own
+    slot. The gate itself plus a parity pin: float-key reduce results
+    are identical with the hash path enabled and disabled (both route
+    to the sort lowering), including the -0.0 == 0.0 merge."""
+    from bigslice_tpu.slicetype import ColType, Schema
+
+    ex = _hash_session().executor
+    fschema = Schema([ColType(np.dtype(np.float32), "", ()),
+                      ColType(np.dtype(np.int32), "", ())], 1)
+
+    class FC:  # minimal combiner stand-in for the gate call
+        fn = staticmethod(lambda a, b: a + b)
+        nvals = 1
+        dense_keys = None
+
+    assert ex._hash_combine_ops("op", FC(), fschema) is None
+
+    n_rows = 1 << 12
+    rng = np.random.RandomState(23)
+    keys = rng.randint(-8, 8, n_rows).astype(np.float32)
+    keys[keys == 0.0] = np.where(
+        rng.rand(int((keys == 0.0).sum())) < 0.5, -0.0, 0.0
+    ).astype(np.float32)
+    vals = np.ones(n_rows, np.int32)
+
+    def run(hash_aggregate):
+        sess = Session(executor=MeshExecutor(
+            _mesh(), auto_dense=False, hash_aggregate=hash_aggregate
+        ))
+        res = sess.run(bs.Reduce(bs.Const(8, keys, vals),
+                                 lambda a, b: a + b))
+        assert sess.executor.device_group_count() > 0
+        rows = sorted(
+            (float(k), int(v)) for f in res.frames()
+            for k, v in zip(*f.to_host().cols)
+        )
+        return rows
+
+    hash_on = run(True)
+    hash_off = run(False)
+    assert hash_on == hash_off
+    # -0.0 and 0.0 merged into ONE key row under IEEE == semantics.
+    zero_rows = [r for r in hash_on if r[0] == 0.0]
+    assert len(zero_rows) == 1
+    ref = collections.defaultdict(int)
+    for k in keys.tolist():
+        ref[float(k)] += 1
+    assert hash_on == sorted(ref.items())
