@@ -33,6 +33,7 @@ from bigslice_tpu.exec.task import (
     TaskState,
     iter_tasks,
 )
+from bigslice_tpu.utils import faultinject
 
 MAX_CONSECUTIVE_LOST = 5  # exec/eval.go:30
 
@@ -187,6 +188,15 @@ class _Evaluation:
                 ),
             )
             return False
+        if faultinject.ENABLED:
+            # Chaos seam: the submission is lost in flight (an executor
+            # accepting a task, then its machine dying before a state
+            # transition). mark_lost re-enters this ladder, still
+            # bounded by the consecutive-loss cap above.
+            fault = faultinject.fire("eval.resubmit")
+            if fault is not None:
+                task.mark_lost(faultinject.injected_error(fault))
+                return False
         if task.transition_if(st, TaskState.WAITING):
             self.executor.submit(task)
             return True
@@ -250,8 +260,15 @@ class _Evaluation:
             return
         if any(t.state == TaskState.ERR for t in self.tasks):
             return  # the event loop will surface it
+        # Name the wedged state instead of a bare "stalled": the
+        # operator debugging a hang needs the task-state census, not a
+        # rerun under a debugger.
+        states: Dict[str, int] = {}
+        for t in self.tasks:
+            states[t.state.name] = states.get(t.state.name, 0) + 1
         raise RuntimeError(
-            "evaluation stalled: no runnable or running tasks"
+            f"evaluation stalled: no runnable or running tasks "
+            f"(task states: {states})"
         )
 
     def _drain(self, timeout: float = 30.0) -> None:
@@ -266,3 +283,31 @@ class _Evaluation:
                 return
             with self.cond:
                 self.cond.wait(timeout=0.2)
+        # Timeout expired with tasks still in flight: say WHICH, both in
+        # the log and through the monitor chain (the telemetry hub opts
+        # in via on_drain_timeout and surfaces the census in its
+        # summary/Prometheus export) — a silent give-up here hides
+        # exactly the wedge a post-mortem needs.
+        wedged = [
+            {"task": str(t.name), "state": t.state.name}
+            for t in self.tasks
+            if t.state in (TaskState.WAITING, TaskState.RUNNING)
+        ]
+        if not wedged:
+            return
+        import logging
+
+        head = ", ".join(
+            f"{w['task']}={w['state']}" for w in wedged[:16]
+        )
+        if len(wedged) > 16:
+            head += f", ... ({len(wedged) - 16} more)"
+        logging.getLogger("bigslice.evaluate").warning(
+            "drain timeout (%.0fs): %d task(s) still in flight: %s",
+            timeout, len(wedged), head,
+        )
+        fn = getattr(self.monitor, "on_drain_timeout", None)
+        if fn is not None:
+            from bigslice_tpu.utils.status import safe_monitor_call
+
+            safe_monitor_call(fn, wedged, key=id(self.monitor))

@@ -251,7 +251,15 @@ class LocalExecutor:
             # streaming sortio merge (reduce.go:73-78).
             def gen():
                 for t in dep.tasks:
-                    yield from open_one(t)
+                    # Missing can surface MID-STREAM too, not only at
+                    # open: a streaming FileStore read that hits a
+                    # corrupt frame quarantines the file and raises
+                    # Missing from inside the iterator. Either way the
+                    # producer is lost, not the consumer failed.
+                    try:
+                        yield from open_one(t)
+                    except store_mod.Missing as e:
+                        raise DepLost(t) from e
 
             return gen()
 

@@ -58,6 +58,7 @@ from bigslice_tpu.parallel.jitutil import (
 )
 from bigslice_tpu.parallel.meshutil import get_shard_map, mesh_axis
 from bigslice_tpu.parallel import shuffle as shuffle_mod
+from bigslice_tpu.utils import faultinject, fileio
 
 # Group-completion watchdog: if the evaluator hands us only part of an op
 # group (other shards already OK from a prior run), run the stragglers on
@@ -1314,6 +1315,13 @@ class MeshExecutor:
                 # Fail fast on a wedged peer instead of entering a
                 # collective that can never complete.
                 self._keepalive.check()
+            if faultinject.ENABLED:
+                # Chaos seam on SPMD dispatch: 'infra' rides the
+                # probation → host-tier resubmit ladder below;
+                # 'hostloss' rides the gang-loss → elastic ladder.
+                fault = faultinject.fire("mesh.dispatch")
+                if fault is not None:
+                    raise faultinject.injected_error(fault)
             self._execute_group(key, tasks)
             with self._lock:
                 for t in tasks:
@@ -2516,8 +2524,16 @@ class MeshExecutor:
                 )
             t0 = time.perf_counter()
             try:
-                host_cols, counts, capacity, bufs = staging_mod.assemble(
-                    shard_lists, schema, self.nmesh, self.staging_arena
+                # retry_transient: a transient staging failure (chaos
+                # seam or a real flaky host) re-runs the assembly —
+                # both calls fail at entry or are functional over
+                # their inputs, so a retry is side-effect-safe.
+                host_cols, counts, capacity, bufs = fileio.retry_transient(
+                    lambda: staging_mod.assemble(
+                        shard_lists, schema, self.nmesh,
+                        self.staging_arena,
+                    ),
+                    "staging.assemble",
                 )
             except staging_mod.StagingFallback:
                 pass
@@ -2525,8 +2541,11 @@ class MeshExecutor:
                 _stat_add(stats, "assemble_s",
                           time.perf_counter() - t0)
                 t1 = time.perf_counter()
-                cols, counts_arr = shuffle_mod.place_global_columns(
-                    self.mesh, host_cols, counts
+                cols, counts_arr = fileio.retry_transient(
+                    lambda: shuffle_mod.place_global_columns(
+                        self.mesh, host_cols, counts
+                    ),
+                    "shuffle.upload",
                 )
                 if self.staging_arena.mode == "recycle":
                     # The transfer detaches from the host buffers
@@ -2567,8 +2586,11 @@ class MeshExecutor:
         capacity = bucket_size(max(counts + [1]))
         _stat_add(stats, "assemble_s", time.perf_counter() - t0)
         t1 = time.perf_counter()
-        cols, counts_arr = shuffle_mod.shard_columns(
-            self.mesh, per_shard_cols, counts, capacity
+        cols, counts_arr = fileio.retry_transient(
+            lambda: shuffle_mod.shard_columns(
+                self.mesh, per_shard_cols, counts, capacity
+            ),
+            "shuffle.upload",
         )
         _stat_add(stats, "upload_s", time.perf_counter() - t1)
         # owned=True: these arrays were placed for this wave alone —

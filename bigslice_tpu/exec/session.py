@@ -49,6 +49,20 @@ def _is_gang_loss(e: BaseException) -> bool:
     return False
 
 
+def _elastic_backoff_delay(attempt: int) -> float:
+    """Delay before elastic recovery round ``attempt`` (0-based):
+    base * 2^attempt, capped at 30s, with up to 25% jitter."""
+    import os
+    import random
+
+    base = float(os.environ.get("BIGSLICE_ELASTIC_BACKOFF", "0.2"))
+    if base <= 0:
+        return 0.0
+    return min(base * (2 ** attempt), 30.0) * (
+        1.0 + 0.25 * random.random()
+    )
+
+
 class _InvocationGate:
     """Reader-writer isolation for exclusive invocations: normal runs
     share the session (readers); an exclusive Func's run takes the whole
@@ -110,7 +124,12 @@ class Result(Slice):
             # Re-evaluate-before-read with retry: outputs may vanish
             # between evaluation and the scan (machine loss); mark the
             # task lost and re-run its (transitive) producers
-            # (newEvalReader, exec/bigmachine.go:1485-1535).
+            # (newEvalReader, exec/bigmachine.go:1485-1535). Missing
+            # can also surface MID-STREAM (a corrupt frame quarantined
+            # by the FileStore during the scan) — same recovery, but
+            # frames already yielded must not repeat, so the re-read
+            # restarts the shard's stream from scratch only if nothing
+            # was emitted yet; a partially-consumed stream re-raises.
             last = None
             for _ in range(MAX_CONSECUTIVE_LOST):
                 if task.state != TaskState.OK:
@@ -121,7 +140,17 @@ class Result(Slice):
                     last = e
                     task.mark_lost(e)
                     continue
-                yield from r
+                emitted = False
+                try:
+                    for f in r:
+                        emitted = True
+                        yield f
+                except Missing as e:
+                    task.mark_lost(e)
+                    if emitted:
+                        raise
+                    last = e
+                    continue
                 return
             raise last
 
@@ -421,6 +450,19 @@ class Session:
                     break
                 if attempts >= self.elastic or not _is_gang_loss(err):
                     raise err
+                # Bounded exponential backoff + jitter between elastic
+                # rounds: a just-died mesh re-probed instantly tends to
+                # be the same dead mesh, and a tight retry loop burns
+                # every elastic attempt inside the outage window
+                # (BIGSLICE_ELASTIC_BACKOFF = base seconds; 0 disables).
+                delay = _elastic_backoff_delay(attempts)
+                if delay > 0:
+                    self._event("bigslice:elasticBackoff",
+                                attempt=attempts,
+                                delay_s=round(delay, 3))
+                    import time as _time
+
+                    _time.sleep(delay)
                 # Recovery mutates the shared executor (mesh swap), so
                 # quiesce the session first: trade our reader slot for
                 # the writer (waits out concurrent runs; new runs block

@@ -50,6 +50,7 @@ import numpy as np
 
 from bigslice_tpu.frame.frame import Frame
 from bigslice_tpu.parallel.jitutil import bucket_size
+from bigslice_tpu.utils import faultinject
 
 
 class StagingFallback(Exception):
@@ -278,6 +279,10 @@ def assemble(per_shard_frames: Sequence[Sequence[Frame]],
     Returns ``(host_cols, counts, capacity, bufs)`` where ``bufs`` are
     the arena buffers to release after upload. Raises StagingFallback
     for shapes outside the contract (object columns, dtype drift)."""
+    # Chaos seam at ENTRY (before any arena state moves): an injected
+    # transient here is retried by the executor's staging retry loop.
+    if faultinject.ENABLED:
+        faultinject.maybe_raise("staging.assemble")
     lists = [list(fl) for fl in per_shard_frames]
     if len(lists) > nmesh:
         raise ValueError(

@@ -14,6 +14,7 @@ alongside HBM-resident working sets; the file tier is local disk or GCS.
 
 from __future__ import annotations
 
+import contextlib
 import os
 import threading
 from typing import Dict, Iterator, List, Optional, Tuple
@@ -21,11 +22,22 @@ from typing import Dict, Iterator, List, Optional, Tuple
 from bigslice_tpu.frame import codec
 from bigslice_tpu.frame.frame import Frame
 from bigslice_tpu.exec.task import TaskName
-from bigslice_tpu.utils import fileio
+from bigslice_tpu.utils import faultinject, fileio
 
 
 class Missing(KeyError):
     """The requested (task, partition) output is not committed."""
+
+
+def _injected_loss(name: TaskName, partition: int,
+                   fault) -> Missing:
+    """A chaos-plane ``store.read`` loss surfaces as Missing — the same
+    retriable signal a real machine loss produces — carrying the fault
+    marker so telemetry attributes the recovery to the site."""
+    e = Missing(f"{name} p{partition} (injected store loss)")
+    e.fault = fault
+    e.fault_site = fault.site
+    return e
 
 
 class Store:
@@ -77,6 +89,14 @@ class MemoryStore(Store):
             return (name, partition) in self._data
 
     def read(self, name, partition):
+        if faultinject.ENABLED:
+            f = faultinject.fire("store.read")
+            if f is not None:
+                # The committed entry vanishes, as if the machine
+                # holding it died between produce and serve.
+                with self._lock:
+                    self._data.pop((name, partition), None)
+                raise _injected_loss(name, partition, f)
         with self._lock:
             frames = self._data.get((name, partition))
         if frames is None:
@@ -106,6 +126,10 @@ class FileStore(Store):
 
     def __init__(self, prefix: str):
         self.prefix = prefix
+        # Corrupt partition files detected on read are moved aside (see
+        # _quarantine) so recompute's fresh put replaces them; counter
+        # for tests/observability.
+        self.quarantined = 0
         self._warm_lock = threading.Lock()
         # (name, partition) -> list[Frame]. Failed prefetches insert
         # nothing: read() falls through to the direct path, which
@@ -133,6 +157,16 @@ class FileStore(Store):
         )
 
     def put(self, name, partition, frames):
+        if faultinject.ENABLED:
+            # Entry seam, BEFORE the frames iterator is touched: a
+            # transient failure here is retryable (and retried) without
+            # re-consuming a possibly one-shot stream. Mid-write
+            # failures propagate — atomic_write guarantees no partial
+            # file is ever observed either way.
+            fileio.retry_transient(
+                lambda: faultinject.maybe_raise("store.put"),
+                "store.put",
+            )
         with self._warm_lock:
             # New contents supersede anything warmed or in flight.
             self._warm_gen[name] = self._warm_gen.get(name, 0) + 1
@@ -164,26 +198,45 @@ class FileStore(Store):
             ).start()
 
     def _prefetch_loop(self) -> None:
-        while True:
+        # The worker-live flag MUST retire on every exit path: a loop
+        # body that escaped with the flag still set would kill prefetch
+        # for the rest of the session (no future hint would ever spawn
+        # a replacement worker) — the failure mode the per-item
+        # isolation below plus this outer guard make impossible.
+        try:
+            while True:
+                with self._warm_lock:
+                    if not self._warm_queue:
+                        self._warm_worker_live = False
+                        return
+                    key, gen = self._warm_queue.pop(0)
+                try:
+                    self._prefetch_one(key, gen)
+                except BaseException:  # noqa: BLE001 — isolate items
+                    # One poisoned item never kills the worker; the
+                    # direct read path raises the authoritative error.
+                    with self._warm_lock:
+                        self._warm_pending.discard(key)
+        except BaseException:  # noqa: BLE001 — bookkeeping raised
             with self._warm_lock:
-                if not self._warm_queue:
-                    self._warm_worker_live = False
-                    return
-                key, gen = self._warm_queue.pop(0)
-            name, partition = key
-            try:
-                frames = list(self._read_direct(name, partition))
-            except BaseException:  # noqa: BLE001 — read() re-raises
-                frames = None      # the authoritative error itself
-            with self._warm_lock:
-                self._warm_pending.discard(key)
-                if (frames is not None
-                        and self._warm_gen.get(name, 0) == gen):
-                    # Generation unchanged: no discard()/put() raced
-                    # this read — the frames are current.
-                    self._warm[key] = frames
-                    while len(self._warm) > self.PREFETCH_CACHE_MAX:
-                        self._warm.pop(next(iter(self._warm)))
+                self._warm_worker_live = False
+            raise
+
+    def _prefetch_one(self, key, gen: int) -> None:
+        name, partition = key
+        try:
+            frames = list(self._read_direct(name, partition))
+        except BaseException:  # noqa: BLE001 — read() re-raises
+            frames = None      # the authoritative error itself
+        with self._warm_lock:
+            self._warm_pending.discard(key)
+            if (frames is not None
+                    and self._warm_gen.get(name, 0) == gen):
+                # Generation unchanged: no discard()/put() raced
+                # this read — the frames are current.
+                self._warm[key] = frames
+                while len(self._warm) > self.PREFETCH_CACHE_MAX:
+                    self._warm.pop(next(iter(self._warm)))
 
     def read(self, name, partition):
         # One-shot warm-cache hit: prefetched frames serve the read
@@ -197,6 +250,13 @@ class FileStore(Store):
 
     def _read_direct(self, name, partition):
         path = self._path(name, partition)
+        if faultinject.ENABLED:
+            f = faultinject.fire("store.read")
+            if f is not None:
+                # The committed file vanishes, as if the machine
+                # holding it died between produce and serve.
+                fileio.remove(path)
+                raise _injected_loss(name, partition, f)
         try:
             fp = fileio.open_read(path)
         except FileNotFoundError as e:
@@ -206,10 +266,31 @@ class FileStore(Store):
             raise Missing(f"{name} p{partition}") from e
 
         def stream():
-            with fp:
-                yield from codec.read_stream(fp)
+            try:
+                with fp:
+                    yield from codec.read_stream(fp)
+            except codec.CorruptionError as e:
+                # A corrupt shuffle file is a *lost* output, not a run
+                # error: quarantine the file (so recompute's fresh put
+                # replaces it and committed() stops claiming it) and
+                # surface Missing — the DepLost → recompute ladder,
+                # bounded by MAX_CONSECUTIVE_LOST, is the recovery.
+                self._quarantine(path)
+                raise Missing(
+                    f"{name} p{partition} (corrupt file quarantined)"
+                ) from e
 
         return stream()
+
+    def _quarantine(self, path: str) -> None:
+        """Move a corrupt partition file aside (best-effort removal if
+        the rename fails): it must stop being served and stop counting
+        as committed, but stays on disk for post-mortem."""
+        self.quarantined += 1
+        try:
+            fileio.rename(path, path + ".quarantine")
+        except Exception:  # noqa: BLE001 — removal is the fallback
+            fileio.remove(path)
 
     def discard(self, name):
         with self._warm_lock:  # never serve a discarded task's frames

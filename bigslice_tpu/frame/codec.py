@@ -50,6 +50,7 @@ import numpy as np
 
 from bigslice_tpu.frame.frame import Frame
 from bigslice_tpu.slicetype import Schema
+from bigslice_tpu.utils import faultinject
 
 MAGIC = b"BSF3"    # legacy container (npy numeric payloads)
 MAGIC4 = b"BSF4"   # raw-payload container (zero-copy decode)
@@ -422,6 +423,20 @@ def _read_exact(fp, n: int) -> bytes:
     return b"".join(parts)
 
 
+def _corrupt_body(body: bytes, kind: str) -> bytes:
+    """Chaos-plane frame damage: what a bad NIC/disk would have done.
+    ``flip`` flips one payload bit (CRC catches it), ``truncate`` cuts
+    the body short (the envelope length check catches it). Either way
+    the *organic* CorruptionError path fires — the injection corrupts
+    data, it never fakes the detector."""
+    if kind == "truncate":
+        return body[: len(body) // 2]
+    ba = bytearray(body)
+    if ba:
+        ba[len(ba) // 2] ^= 0x40
+    return bytes(ba)
+
+
 def read_stream(fp: BinaryIO) -> Iterator[Frame]:
     """Incrementally decode frames from a file object — one frame's bytes
     resident at a time (spill-merge reads depend on this bound). BSF4
@@ -436,5 +451,9 @@ def read_stream(fp: BinaryIO) -> Iterator[Frame]:
             raise CorruptionError("bad frame header in stream")
         (blen, _crc) = struct.unpack_from("<QI", header, 4)
         body = _read_exact(fp, blen)
+        if faultinject.ENABLED:
+            fault = faultinject.fire("codec.read")
+            if fault is not None:
+                body = _corrupt_body(body, fault.kind)
         frame, _ = decode_frame(header + body)
         yield frame

@@ -671,6 +671,15 @@ def place_global_columns(mesh, globs: Sequence[np.ndarray], counts):
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    # Chaos seam at entry (also covers shard_columns, which lands
+    # here): an injected transient upload failure is retried by the
+    # executor's staging retry loop — the call is functional, so a
+    # retry re-places the same host data.
+    from bigslice_tpu.utils import faultinject
+
+    if faultinject.ENABLED:
+        faultinject.maybe_raise("shuffle.upload")
+
     nshards = mesh.devices.size
     # Shard axis 0 over EVERY mesh axis: 1-D meshes get the usual
     # P("shards"); 2-D (dcn, ici) meshes get P(("dcn","ici")) — shard

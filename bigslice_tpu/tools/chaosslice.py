@@ -1,0 +1,225 @@
+"""Chaos runner: execute a pipeline under a deterministic fault plan
+and report the recovery matrix.
+
+The reference proves fault tolerance with a randomized chaos-monkey
+test (exec/chaosmonkey_test.go:44-103); this tool is the operational
+version over the deterministic plane (utils/faultinject.py): run a
+known-answer shuffle pipeline twice — fault-free, then under a seeded
+``BIGSLICE_CHAOS``-style plan — assert the results are bit-identical,
+and emit a **recovery matrix**: per injection site, how many faults
+fired, how many lost tasks the ladder recovered (attributed back to the
+site through the exception-chain markers; corruption-induced losses
+surface in the ``organic`` bucket, see utils/faultinject.py), how many
+turned fatal, and the loss→OK time-to-recovery quantiles.
+
+Because the plan is seeded, a failing matrix is a *replayable bug
+report*: rerun with the same spec and the same faults fire at the same
+``(site, invocation_id)`` coordinates.
+
+Usage:
+    python -m bigslice_tpu.tools.chaosslice \
+        -chaos "7:store.read=0.08x6,codec.read=0.05x2~flip,io.read=0.2x3" \
+        [-rows N] [-shards S] [-nkeys K] [-mesh] [-elastic N] \
+        [-json OUT.json] [-list-sites]
+
+``-chaos`` defaults to ``$BIGSLICE_CHAOS``. Local runs use a FileStore
+in a temp dir (exercising the file/codec sites); ``-mesh`` runs the
+mesh executor (dispatch/staging/upload/memory-loss sites) with elastic
+mesh recovery enabled for injected host loss.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+from bigslice_tpu.utils import faultinject
+
+
+def _pipeline(shards: int, keys, vals):
+    import bigslice_tpu as bs
+
+    return bs.Reduce(bs.Const(shards, keys, vals), lambda a, b: a + b)
+
+
+def _run_once(use_mesh: bool, store_dir, rows: int, shards: int,
+              nkeys: int, elastic: int = 0):
+    """One full session run; returns (sorted rows, telemetry summary,
+    wall seconds)."""
+    from bigslice_tpu.exec.session import Session
+
+    rng = np.random.RandomState(11)
+    keys = rng.randint(0, nkeys, rows).astype(np.int32)
+    vals = rng.randint(0, 100, rows).astype(np.int32)
+    if use_mesh:
+        import jax
+        from jax.sharding import Mesh
+
+        from bigslice_tpu.exec.meshexec import MeshExecutor
+
+        executor = MeshExecutor(Mesh(np.array(jax.devices()),
+                                     ("shards",)))
+    else:
+        from bigslice_tpu.exec import store as store_mod
+        from bigslice_tpu.exec.local import LocalExecutor
+
+        executor = LocalExecutor(
+            store=store_mod.FileStore(store_dir)
+        )
+    sess = Session(executor=executor, elastic=elastic)
+    t0 = time.monotonic()
+    try:
+        res = sess.run(_pipeline(shards, keys, vals))
+        out = sorted(res.rows())
+    finally:
+        wall = time.monotonic() - t0
+        summary = sess.telemetry_summary()
+        sess.shutdown()
+    return out, summary, wall
+
+
+def _matrix(plan_snap: dict, recovery: dict) -> list:
+    """Rows of the site × injected/recovered/fatal matrix."""
+    by_site = (recovery or {}).get("by_site", {})
+    sites = sorted(set(plan_snap.get("injected", {}))
+                   | set(by_site))
+    rows = []
+    for site in sites:
+        rec = by_site.get(site, {})
+        lat = rec.get("latency", {})
+        rows.append({
+            "site": site,
+            "injected": plan_snap.get("injected", {}).get(site, 0),
+            "recovered": rec.get("recovered", 0),
+            "fatal": rec.get("fatal", 0),
+            "ttr_p50_s": lat.get("p50_s"),
+            "ttr_p90_s": lat.get("p90_s"),
+            "ttr_max_s": lat.get("max_s"),
+        })
+    return rows
+
+
+def _print_matrix(rows: list) -> None:
+    print(f"  {'site':<20} {'injected':>8} {'recovered':>9} "
+          f"{'fatal':>6} {'ttr_p50_ms':>11} {'ttr_max_ms':>11}")
+    for r in rows:
+        p50 = (f"{r['ttr_p50_s'] * 1e3:.1f}"
+               if r["ttr_p50_s"] is not None else "-")
+        mx = (f"{r['ttr_max_s'] * 1e3:.1f}"
+              if r["ttr_max_s"] is not None else "-")
+        print(f"  {r['site']:<20} {r['injected']:>8} "
+              f"{r['recovered']:>9} {r['fatal']:>6} {p50:>11} "
+              f"{mx:>11}")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="chaosslice",
+        description="run a pipeline under a deterministic fault plan "
+                    "and emit the recovery matrix",
+    )
+    p.add_argument("-chaos", default=None,
+                   help="seed:spec plan (default: $BIGSLICE_CHAOS)")
+    p.add_argument("-rows", type=int, default=20000)
+    p.add_argument("-shards", type=int, default=8)
+    p.add_argument("-nkeys", type=int, default=199)
+    p.add_argument("-mesh", action="store_true",
+                   help="run on the mesh executor (CPU mesh in tests)")
+    p.add_argument("-elastic", type=int, default=2,
+                   help="elastic mesh-recovery retries (mesh only)")
+    p.add_argument("-json", dest="json_path", default=None)
+    p.add_argument("-list-sites", action="store_true")
+    args = p.parse_args(argv)
+
+    if args.list_sites:
+        for name, info in sorted(faultinject.sites().items()):
+            kinds = "/".join(info["kinds"])
+            print(f"{name:<20} [{kinds}] {info['doc']}")
+        return 0
+
+    import os
+
+    spec = args.chaos or os.environ.get("BIGSLICE_CHAOS")
+    if not spec:
+        print("chaosslice: no plan (-chaos or $BIGSLICE_CHAOS)",
+              file=sys.stderr)
+        return 2
+
+    try:
+        parsed = faultinject.parse_plan(spec)
+    except ValueError as e:
+        print(f"chaosslice: bad plan: {e}", file=sys.stderr)
+        return 2
+
+    elastic = args.elastic if args.mesh else 0
+    with tempfile.TemporaryDirectory(prefix="chaosslice-") as tmp:
+        # Fault-free baseline first: the ground truth the chaos run
+        # must match bit-for-bit.
+        faultinject.clear()
+        baseline, _, base_wall = _run_once(
+            args.mesh, f"{tmp}/base", args.rows, args.shards,
+            args.nkeys,
+        )
+        plan = faultinject.install(parsed)
+        err = None
+        try:
+            chaos_rows, summary, chaos_wall = _run_once(
+                args.mesh, f"{tmp}/chaos", args.rows, args.shards,
+                args.nkeys, elastic=elastic,
+            )
+        except Exception as e:  # noqa: BLE001 — reported, not raised
+            err = e
+            chaos_rows, summary, chaos_wall = None, {}, 0.0
+        finally:
+            faultinject.clear()
+
+    snap = plan.snapshot()
+    recovery = summary.get("recovery", {})
+    matrix = _matrix(snap, recovery)
+    match = chaos_rows == baseline
+
+    print(f"chaosslice: plan seed={snap['seed']} "
+          f"({sum(snap['injected'].values())} faults injected over "
+          f"{len(snap['log'])} log entries)")
+    print(f"# recovery matrix "
+          f"(site x injected/recovered/fatal, time-to-recovery)")
+    _print_matrix(matrix)
+    if err is not None:
+        site = faultinject.fault_site_of(err) or "?"
+        print(f"run FAILED (fault site {site}): {err!r}")
+    else:
+        print(f"results {'bit-identical to' if match else 'DIVERGED from'}"
+              f" fault-free run "
+              f"({len(baseline)} keys; base {base_wall:.2f}s, "
+              f"chaos {chaos_wall:.2f}s)")
+
+    if args.json_path:
+        doc = {
+            "spec": spec,
+            "mesh": bool(args.mesh),
+            "rows": args.rows,
+            "shards": args.shards,
+            "ok": err is None,
+            "bit_identical": bool(match),
+            "error": repr(err) if err is not None else None,
+            "wall_s": {"baseline": round(base_wall, 3),
+                       "chaos": round(chaos_wall, 3)},
+            "matrix": matrix,
+            "plan": snap,
+            "recovery": recovery,
+            "drain": summary.get("drain"),
+        }
+        with open(args.json_path, "w") as fp:
+            json.dump(doc, fp, indent=2)
+        print(f"wrote {args.json_path}")
+
+    return 0 if (err is None and match) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

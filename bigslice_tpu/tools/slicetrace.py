@@ -27,6 +27,10 @@ plus the telemetry-hub sections (utils/telemetry.py):
   staging time went (read / decode / assemble / upload — the staging
   fast path's stages, exec/staging.py). Rendered only for traces whose
   staging instants carry the breakdown fields.
+- ``invN:recovery`` — per op × attributed fault site, lost tasks the
+  recovery ladder brought back and the loss→OK latency (from
+  ``bigslice:taskRecovered`` instants; the chaos plane's replayable
+  recovery evidence, utils/faultinject.py + tools/chaosslice.py).
 
 Traces from older sessions (no ``inv`` task args) fall back to one
 flat all-ops quartile table.
@@ -110,6 +114,7 @@ def _print_inv(out: List[str], inv, summary: dict, tasks: List[dict],
     _print_skew(out, inv, telem.get("skew", ()))
     _print_overlap(out, inv, telem.get("staging", ()),
                    telem.get("runs", ()))
+    _print_recovery(out, inv, telem.get("recovery", ()))
     out.append("")
 
 
@@ -221,6 +226,32 @@ def _print_overlap(out: List[str], inv, staging, runs):
         )
 
 
+def _print_recovery(out: List[str], inv, events):
+    """Recovery-ladder section from bigslice:taskRecovered instants:
+    per op × attributed fault site, how many lost tasks came back and
+    how long loss→OK took (the chaos plane's recovery evidence,
+    utils/faultinject.py)."""
+    agg: Dict[tuple, List[float]] = {}
+    for ev in events:
+        a = ev.get("args", {})
+        key = (a.get("op", "?"), a.get("site", "organic"))
+        agg.setdefault(key, []).append(
+            float(a.get("latency_s", 0.0)) * 1e3
+        )
+    if not agg:
+        return
+    out.append(f"# inv{inv}:recovery (lost tasks recovered, by "
+               f"attributed fault site)")
+    out.append(f"  {'op':<28} {'site':<18} {'n':>4} {'med_ms':>9} "
+               f"{'max_ms':>9}")
+    for (op, site), lats in sorted(agg.items()):
+        _, _, med, _, mx = quartiles(lats)
+        out.append(
+            f"  {op[:28]:<28} {site[:18]:<18} {len(lats):>4} "
+            f"{med:>9.2f} {mx:>9.2f}"
+        )
+
+
 def analyze(path: str) -> str:
     with open(path) as fp:
         doc = json.load(fp)
@@ -231,6 +262,7 @@ def analyze(path: str) -> str:
         "bigslice:shuffleSizes": "skew",
         "bigslice:waveStaging": "staging",
         "bigslice:waveRun": "runs",
+        "bigslice:taskRecovered": "recovery",
     }
     n_tasks = n_instants = 0
     for ev in doc.get("traceEvents", []):

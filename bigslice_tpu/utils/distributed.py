@@ -200,6 +200,14 @@ class Keepalive:
 
     def check(self) -> None:
         """Raise PeerLostError if any peer's beat has gone stale."""
+        from bigslice_tpu.utils import faultinject
+
+        if faultinject.ENABLED:
+            f = faultinject.fire("peer.lost")
+            if f is not None:
+                # Injected stale-beat verdict: the wedged-peer class
+                # the keepalive exists to catch, without the wedge.
+                raise faultinject.injected_error(f)
         if not self._lost:
             return
         desc = ", ".join(

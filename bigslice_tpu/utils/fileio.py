@@ -9,6 +9,16 @@ fsspec: paths containing ``://`` route to the named filesystem
 Atomicity: local writes go through tmp-file + ``os.replace`` (readers
 never observe partial files); object stores commit a PUT atomically on
 close, so URL writes target the final key directly.
+
+Transient-failure policy: opens and commits retry with bounded
+exponential backoff + jitter (``retry_transient``; knobs
+``BIGSLICE_IO_RETRIES`` / ``BIGSLICE_IO_BACKOFF``) — remote object
+stores and network filesystems fail transiently as a matter of course,
+and a zero-retry read turning into a fatal task error is exactly the
+gap the chaos plane (utils/faultinject.py, sites ``io.read`` /
+``io.commit``) exists to keep closed. True absence
+(``FileNotFoundError``) never retries: it is the store tier's
+``Missing`` signal, and delaying it only delays recovery.
 """
 
 from __future__ import annotations
@@ -16,11 +26,51 @@ from __future__ import annotations
 import contextlib
 import os
 import tempfile
-from typing import BinaryIO, Iterator, Tuple
+from typing import BinaryIO, Callable, Iterator, Tuple
+
+from bigslice_tpu.utils import faultinject
 
 
 def is_url(path: str) -> bool:
     return "://" in path
+
+
+# -- transient-failure retry ----------------------------------------------
+
+# Deterministic-outcome OSErrors: retrying cannot change the answer
+# (absence is the Missing signal; permissions do not heal in 40ms).
+_NON_TRANSIENT = (FileNotFoundError, IsADirectoryError,
+                  NotADirectoryError, PermissionError)
+
+
+def io_retries() -> int:
+    env = os.environ.get("BIGSLICE_IO_RETRIES")
+    if env is not None:
+        return max(0, int(env))
+    return 2
+
+
+def retry_transient(fn: Callable, what: str = "io"):
+    """``fn()`` with up to ``io_retries()`` retries on transient
+    OSErrors, exponential backoff + jitter between attempts. Non-OSError
+    exceptions and the ``_NON_TRANSIENT`` classes propagate
+    immediately."""
+    import random
+    import time
+
+    retries = io_retries()
+    base = float(os.environ.get("BIGSLICE_IO_BACKOFF", "0.02"))
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except OSError as e:
+            if isinstance(e, _NON_TRANSIENT) or attempt >= retries:
+                raise
+            delay = base * (2 ** attempt) * (1.0 + random.random())
+            attempt += 1
+            if delay > 0:
+                time.sleep(delay)
 
 
 def _fs(path: str):
@@ -39,19 +89,46 @@ def join(*parts: str) -> str:
 
 
 def exists(path: str) -> bool:
-    if is_url(path):
-        fs, p = _fs(path)
-        return fs.exists(p)
-    return os.path.exists(path)
+    def attempt():
+        if is_url(path):
+            fs, p = _fs(path)
+            return fs.exists(p)
+        return os.path.exists(path)
+
+    return retry_transient(attempt, f"exists {path}")
 
 
 def open_read(path: str) -> BinaryIO:
     """Open for streaming binary read; raises FileNotFoundError when
-    absent (both tiers)."""
-    if is_url(path):
-        fs, p = _fs(path)
-        return fs.open(p, "rb")
-    return open(path, "rb")
+    absent (both tiers). Transient open failures retry with backoff."""
+    def attempt():
+        faultinject.maybe_raise("io.read")
+        if is_url(path):
+            fs, p = _fs(path)
+            return fs.open(p, "rb")
+        return open(path, "rb")
+
+    return retry_transient(attempt, f"open {path}")
+
+
+def remove(path: str) -> None:
+    """Best-effort single-file removal (both tiers)."""
+    with contextlib.suppress(Exception):
+        if is_url(path):
+            fs, p = _fs(path)
+            fs.rm(p)
+        else:
+            os.unlink(path)
+
+
+def rename(src: str, dst: str) -> None:
+    """Atomic-ish rename within one tier (``os.replace`` locally,
+    server-side move on object stores)."""
+    if is_url(src):
+        fs, p = _fs(src)
+        fs.mv(p, _fs(dst)[1])
+        return
+    os.replace(src, dst)
 
 
 @contextlib.contextmanager
@@ -73,7 +150,12 @@ def atomic_write(path: str) -> Iterator[BinaryIO]:
         try:
             with fs.open(tmp, "wb") as fp:
                 yield fp
-            fs.mv(tmp, p)
+
+            def commit():
+                faultinject.maybe_raise("io.commit")
+                fs.mv(tmp, p)
+
+            retry_transient(commit, f"commit {path}")
             ok = True
         finally:
             if not ok:
@@ -87,7 +169,12 @@ def atomic_write(path: str) -> Iterator[BinaryIO]:
     try:
         with os.fdopen(fd, "wb") as fp:
             yield fp
-        os.replace(tmp, path)
+
+        def commit():
+            faultinject.maybe_raise("io.commit")
+            os.replace(tmp, path)
+
+        retry_transient(commit, f"commit {path}")
         ok = True
     finally:
         if not ok and os.path.exists(tmp):

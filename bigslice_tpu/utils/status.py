@@ -236,4 +236,16 @@ def chain_monitors(*monitors):
                                   key=id(m))
 
         monitor.on_phase = on_phase
+    # Drain-timeout channel (exec/evaluate._drain): members opting in
+    # via on_drain_timeout receive the wedged-task census when an
+    # aborted evaluation's drain expires.
+    drain_mons = [m for m in mons
+                  if getattr(m, "on_drain_timeout", None) is not None]
+    if drain_mons:
+        def on_drain_timeout(wedged):
+            for m in drain_mons:
+                safe_monitor_call(m.on_drain_timeout, wedged,
+                                  key=id(m))
+
+        monitor.on_drain_timeout = on_drain_timeout
     return monitor

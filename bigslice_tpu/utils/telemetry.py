@@ -50,7 +50,9 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
+
+from bigslice_tpu.utils import faultinject
 
 # Flagging thresholds. Deliberately conservative defaults: a production
 # alert that fires on balanced workloads is worse than none. Tests (and
@@ -70,6 +72,12 @@ ROWS_BUCKETS = (100, 1_000, 10_000, 100_000, 1_000_000, 10_000_000)
 # Prometheus-counter monotonicity be damned — an evicted op is one
 # nobody scraped for hundreds of invocations.
 MAX_OPS = 1024
+
+# Bounds on the recovery ladder's bookkeeping: latency samples per site
+# and simultaneously-pending lost tasks tracked (beyond it, recoveries
+# still count — only the latency sample is dropped).
+MAX_RECOVERY_SAMPLES = 4096
+MAX_RECOVERY_PENDING = 4096
 
 
 def quantile(sorted_xs: List[float], p: float) -> float:
@@ -141,6 +149,19 @@ class TelemetryHub:
         self._lock = threading.Lock()
         self._ops: Dict[str, _OpRecord] = {}
         self._state_counts: Dict[tuple, int] = {}
+        # Recovery ladder (the fault-tolerance signal family): LOST
+        # tasks pending recovery (task key -> (first-loss stamp, site)),
+        # per-site recovered/fatal counters, and recovery-latency
+        # samples per site. ``site`` is the chaos plane's injection
+        # site when the loss's failure chain carries a fault marker
+        # (utils/faultinject.py), else "organic".
+        self._recovery_pending: Dict[str, Tuple[float, str]] = {}
+        self._recovered: Dict[str, int] = {}
+        self._recovery_fatal: Dict[str, int] = {}
+        self._recovery_lat: Dict[str, List[float]] = {}
+        # Drain-timeout census (exec/evaluate._drain's wedged report).
+        self._drain_timeouts = 0
+        self._drain_wedged: List[dict] = []
         self._eventer = eventer
         self.skew_ratio = skew_ratio
         self.skew_min_rows = skew_min_rows
@@ -179,6 +200,7 @@ class TelemetryHub:
         now = time.monotonic()
         key = str(task.name)
         straggler = None
+        recovered = None
         with self._lock:
             sk = (task.name.op, state.name)
             self._state_counts[sk] = self._state_counts.get(sk, 0) + 1
@@ -191,6 +213,19 @@ class TelemetryHub:
                 rec.running[key] = times.get(TaskState.RUNNING, now)
                 rec.shards[key] = task.name.shard
             elif state == TaskState.OK:
+                pend = self._recovery_pending.pop(key, None)
+                if pend is not None:
+                    # LOST → ... → OK: the ladder recovered this task.
+                    t_lost, site = pend
+                    times = getattr(task, "state_times", None) or {}
+                    lat = max(0.0, times.get(TaskState.OK, now) - t_lost)
+                    self._recovered[site] = \
+                        self._recovered.get(site, 0) + 1
+                    lats = self._recovery_lat.setdefault(site, [])
+                    if len(lats) < MAX_RECOVERY_SAMPLES:
+                        lats.append(lat)
+                    recovered = {"site": site,
+                                 "latency_s": round(lat, 6)}
                 start = rec.running.pop(key, None)
                 if start is not None:
                     # End stamp from state_times too: the hub may be
@@ -212,8 +247,32 @@ class TelemetryHub:
                                 "p50_s": round(p50, 6),
                             }
                             rec.stragglers.append(straggler)
-            elif state in (TaskState.ERR, TaskState.LOST):
+            elif state == TaskState.LOST:
                 rec.running.pop(key, None)
+                if (key not in self._recovery_pending
+                        and len(self._recovery_pending)
+                        < MAX_RECOVERY_PENDING):
+                    # First loss opens the recovery window (repeat
+                    # losses keep the original stamp: time-to-recovery
+                    # measures loss → healthy, retries included).
+                    site = faultinject.fault_site_of(
+                        getattr(task, "error", None)
+                    ) or "organic"
+                    times = getattr(task, "state_times", None) or {}
+                    self._recovery_pending[key] = (
+                        times.get(TaskState.LOST, now), site,
+                    )
+            elif state == TaskState.ERR:
+                rec.running.pop(key, None)
+                pend = self._recovery_pending.pop(key, None)
+                if pend is not None:
+                    # The ladder gave up (consecutive-loss cap / fatal
+                    # reclassification): a non-recovery, by site.
+                    self._recovery_fatal[pend[1]] = \
+                        self._recovery_fatal.get(pend[1], 0) + 1
+        if recovered is not None:
+            self._emit("bigslice:taskRecovered", op=task.name.op,
+                       inv=task.name.inv_index, task=key, **recovered)
         if straggler is not None:
             self._emit("bigslice:straggler", op=task.name.op,
                        inv=task.name.inv_index, **straggler)
@@ -223,6 +282,15 @@ class TelemetryHub:
             rec = self._op(task.name.op, task.name.inv_index)
             rec.phase_counts[phase] = rec.phase_counts.get(phase, 0) + 1
             rec.max_wave = max(rec.max_wave, int(wave))
+
+    def on_drain_timeout(self, wedged: List[dict]) -> None:
+        """exec/evaluate._drain's expiry census: which tasks were still
+        in flight when an aborted evaluation gave up waiting."""
+        with self._lock:
+            self._drain_timeouts += 1
+            self._drain_wedged = list(wedged)[:64]
+        self._emit("bigslice:drainTimeout", n=len(wedged),
+                   tasks=[w["task"] for w in wedged[:8]])
 
     # -- executor seams ---------------------------------------------------
 
@@ -426,7 +494,7 @@ class TelemetryHub:
             states: Dict[str, int] = {}
             for (_, st), n in self._state_counts.items():
                 states[st] = states.get(st, 0) + n
-            return {
+            out = {
                 "ops": ops,
                 "task_states": states,
                 "skew_flagged_ops": sorted(flagged_ops),
@@ -435,12 +503,76 @@ class TelemetryHub:
                     total_hidden / total_staging, 4
                 ) if total_staging > 0 else None,
             }
+            recovery = self._recovery_summary_locked()
+            if recovery is not None:
+                out["recovery"] = recovery
+            if self._drain_timeouts:
+                out["drain"] = {
+                    "timeouts": self._drain_timeouts,
+                    "wedged": list(self._drain_wedged),
+                }
+        plan = faultinject.active_plan()
+        if plan is not None:
+            snap = plan.snapshot()
+            out["chaos"] = {
+                "seed": snap["seed"],
+                "spec": snap["spec"],
+                "injected": snap["injected"],
+                "by_kind": snap["by_kind"],
+            }
+        return out
+
+    @staticmethod
+    def _lat_stats(lats: List[float]) -> dict:
+        ls = sorted(lats)
+        return {
+            "n": len(ls),
+            "p50_s": round(quantile(ls, 0.5), 6),
+            "p90_s": round(quantile(ls, 0.9), 6),
+            "max_s": round(ls[-1], 6) if ls else 0.0,
+        }
+
+    def _recovery_summary_locked(self) -> Optional[dict]:
+        if not (self._recovered or self._recovery_fatal
+                or self._recovery_pending):
+            return None
+        by_site = {}
+        for site in sorted(set(self._recovered)
+                           | set(self._recovery_fatal)):
+            entry = {
+                "recovered": self._recovered.get(site, 0),
+                "fatal": self._recovery_fatal.get(site, 0),
+            }
+            lats = self._recovery_lat.get(site)
+            if lats:
+                entry["latency"] = self._lat_stats(lats)
+            by_site[site] = entry
+        all_lats = [v for ls in self._recovery_lat.values()
+                    for v in ls]
+        out = {
+            "recovered_total": sum(self._recovered.values()),
+            "fatal_total": sum(self._recovery_fatal.values()),
+            "pending": len(self._recovery_pending),
+            "by_site": by_site,
+        }
+        if all_lats:
+            out["latency"] = self._lat_stats(all_lats)
+        return out
 
     def status_lines(self, limit: int = 4) -> List[str]:
         """Live annotations for the status display: flagged skew and
-        current/flagged stragglers, worst first, bounded."""
+        current/flagged stragglers, worst first, bounded — plus a
+        recovery-ladder line when losses were seen."""
         lines: List[str] = []
         with self._lock:
+            rec_total = sum(self._recovered.values())
+            fatal_total = sum(self._recovery_fatal.values())
+            pending = len(self._recovery_pending)
+            if rec_total or fatal_total or pending:
+                lines.append(
+                    f"  recovery: {rec_total} recovered, "
+                    f"{fatal_total} fatal, {pending} pending"
+                )
             skews = []
             for op, rec in self._ops.items():
                 if rec.skew_flagged:
@@ -607,6 +739,51 @@ class TelemetryHub:
                 for phase, n in sorted(rec.phase_counts.items()):
                     line("bigslice_wave_phase_total",
                          {"op": op, "phase": phase}, n)
+
+            # -- recovery ladder / chaos plane ------------------------
+            metric("bigslice_task_recovered_total",
+                   "Lost tasks the recovery ladder brought back to OK, "
+                   "by attributed fault site ('organic' = no chaos "
+                   "marker in the failure chain).", "counter")
+            for site, n in sorted(self._recovered.items()):
+                line("bigslice_task_recovered_total", {"site": site}, n)
+            metric("bigslice_task_recovery_fatal_total",
+                   "Lost tasks that turned fatal (ERR) instead of "
+                   "recovering, by attributed fault site.", "counter")
+            for site, n in sorted(self._recovery_fatal.items()):
+                line("bigslice_task_recovery_fatal_total",
+                     {"site": site}, n)
+            all_lats = sorted(
+                v for ls in self._recovery_lat.values() for v in ls
+            )
+            if all_lats:
+                metric("bigslice_task_recovery_seconds",
+                       "Time from first loss to recovered-OK per task.",
+                       "summary")
+                for q in (0.5, 0.9, 0.99):
+                    line("bigslice_task_recovery_seconds",
+                         {"quantile": str(q)},
+                         f"{quantile(all_lats, q):.6f}")
+                line("bigslice_task_recovery_seconds_sum", {},
+                     f"{sum(all_lats):.6f}")
+                line("bigslice_task_recovery_seconds_count", {},
+                     len(all_lats))
+            metric("bigslice_drain_timeout_total",
+                   "Aborted-evaluation drains that expired with tasks "
+                   "still in flight.", "counter")
+            line("bigslice_drain_timeout_total", {},
+                 self._drain_timeouts)
+
+        plan = faultinject.active_plan()
+        if plan is not None:
+            snap = plan.snapshot()
+            metric("bigslice_fault_injected_total",
+                   "Chaos-plane injected faults by site and kind "
+                   "(utils/faultinject.py).", "counter")
+            for site in sorted(snap["by_kind"]):
+                for kind, n in sorted(snap["by_kind"][site].items()):
+                    line("bigslice_fault_injected_total",
+                         {"site": site, "kind": kind}, n)
 
         metric("bigslice_stat_total",
                "Framework-internal stats.Map counters.", "counter")
