@@ -278,12 +278,21 @@ def reduce_wave_bench(keys, vals, num_shards: int, iters: int = 3,
     # perf trajectory carries pipeline efficiency, not just throughput.
     summary = sess.telemetry_summary()
     overlap = summary.get("overlap_efficiency")
+    # Device-plane rollup (utils/devicetelemetry.py): compile cost,
+    # instrumented-cache hit/miss, HBM peak — recorded beside rows/sec
+    # so the trajectory carries what each PR paid in compiles and
+    # device memory, not just throughput.
+    device = (summary.get("device") or {}).get("totals", {})
     note(f"reduce_wave[{'pipelined' if pipelined else 'serial'}]: "
          f"{distinct} distinct keys, {num_shards} shards on "
          f"{mesh.devices.size} devices, best {best*1e3:.0f} ms, "
          f"overlap efficiency "
-         f"{overlap if overlap is not None else 'n/a'}")
-    return len(keys) / best, overlap
+         f"{overlap if overlap is not None else 'n/a'}, "
+         f"compile {device.get('compile_s', 0)}s "
+         f"({device.get('compiles', 0)} compiles / "
+         f"{device.get('cache_hits', 0)} hits), "
+         f"hbm peak {device.get('hbm_peak_bytes', 0)}")
+    return len(keys) / best, overlap, device
 
 
 # ------------------------------------------------------------- staging
@@ -1007,15 +1016,17 @@ def run_mode(mode: str, size, fallback: bool) -> None:
         rng = np.random.RandomState(42)
         keys = rng.randint(0, 1 << 20, n_rows).astype(np.int32)
         vals = np.ones(n_rows, dtype=np.int32)
-        serial, serial_overlap = reduce_wave_bench(keys, vals, S,
-                                                   pipelined=False)
-        piped, piped_overlap = reduce_wave_bench(keys, vals, S,
-                                                 pipelined=True)
+        serial, serial_overlap, _ = reduce_wave_bench(keys, vals, S,
+                                                      pipelined=False)
+        piped, piped_overlap, device = reduce_wave_bench(
+            keys, vals, S, pipelined=True
+        )
         note(f"reduce_wave: serial {serial:,.0f} rows/s, pipelined "
              f"{piped:,.0f} rows/s → {piped/serial:.2f}x")
         emit("reduce_wave_e2e_rows_per_sec", piped, "rows/sec", serial,
              overlap_efficiency=piped_overlap,
-             serial_overlap_efficiency=serial_overlap)
+             serial_overlap_efficiency=serial_overlap,
+             device=device)
     elif mode == "reduce-wave-staged":
         # The serving shape: waved Reduce whose shards stage from
         # encoded stream files (read → decode → assemble → upload is

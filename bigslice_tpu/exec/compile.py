@@ -195,6 +195,16 @@ class Compiler:
             # groups (the mesh executor runs all shards of a fused chain
             # as one SPMD program).
             task.chain = chain
+            # Repr-stable partition-config descriptor (no ids): the
+            # device-plane compile telemetry keys cost/memory
+            # attribution on (op, partition config), and ROADMAP item
+            # 3's AOT compiled-program cache will key on the same
+            # shape (registry digest + partition config).
+            task.partition_config = (
+                part.num_partition,
+                bool(part.combiner),
+                bool(part.partition_fn),
+            )
             # The memo key disambiguates same-op task sets compiled for
             # different partition configs (e.g. Reduce vs Reshuffle
             # consumers of one slice) — they must never merge into one

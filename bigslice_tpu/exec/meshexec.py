@@ -126,9 +126,50 @@ _DIST_ERR_MARKERS = (
 )
 
 
+def _exception_chain(e: BaseException, contexts: bool = True):
+    """The failure chain, cycle-safe: explicit causes, TaskError's
+    carried cause, and (by default) implicit ``__context__`` links.
+    Classification walks the chain because device/compile errors now
+    surface through seams (instrumented programs, staging retries,
+    chaos wrappers) that re-raise with context — the top-level type
+    alone is no longer representative. TYPED checks include contexts
+    (session._is_gang_loss's documented precedent: a loss raised
+    inside an except block without ``from`` hangs off __context__);
+    the weaker STRING-marker fallbacks pass ``contexts=False`` so an
+    unrelated error raised after *handling* an infra failure isn't
+    over-matched by the handled failure's stringified remains."""
+    seen = set()
+    stack = [e]
+    while stack:
+        err = stack.pop()
+        if err is None or id(err) in seen:
+            continue
+        seen.add(id(err))
+        yield err
+        cause = getattr(err, "cause", None)  # TaskError carries one
+        if isinstance(cause, BaseException):
+            stack.append(cause)
+        stack.append(err.__cause__)
+        if contexts:
+            stack.append(err.__context__)
+
+
 def _looks_like_host_loss(e: BaseException) -> bool:
-    text = repr(e).lower()
-    return any(m in text for m in _DIST_ERR_MARKERS)
+    """Is a peer/gang loss anywhere in the failure chain? Exception
+    TYPE first (the distributed layer's typed losses — PeerLostError,
+    an already-wrapped HostLostError); the runtime-marker substring
+    scan stays as the fallback for errors that only exist as opaque
+    runtime strings (gloo/coordination-service failures)."""
+    from bigslice_tpu.utils.distributed import PeerLostError
+
+    for err in _exception_chain(e):
+        if isinstance(err, (HostLostError, PeerLostError)):
+            return True
+    for err in _exception_chain(e, contexts=False):
+        text = repr(err).lower()
+        if any(m in text for m in _DIST_ERR_MARKERS):
+            return True
+    return False
 
 
 # How long a device-probed op stays on the host fallback after an
@@ -159,22 +200,48 @@ class _AutoDenseRetry(Exception):
     _execute_group."""
 
 
+# The XLA runtime's exception types, matched by name: the concrete
+# class lives in jaxlib (import-version-dependent), and subclasses
+# (e.g. jax's JaxRuntimeError shim) inherit the name via the MRO walk.
+_INFRA_ERROR_TYPE_NAMES = frozenset({"XlaRuntimeError"})
+
+_INFRA_ERR_MARKERS = (
+    "resource_exhausted", "out of memory", "device halted",
+    "dma error", "dma failed", "dma timed out",
+    "program fingerprint mismatch",
+)
+
+
+def _is_infra_error_type(err: BaseException) -> bool:
+    return any(c.__name__ in _INFRA_ERROR_TYPE_NAMES
+               for c in type(err).__mro__)
+
+
 def _looks_like_infra_error(e: BaseException) -> bool:
     """Device-runtime-layer failures (OOM, DMA, runtime wedges) — the
     'machine lost' class: retryable on the host tier, unlike user-code
     errors (which re-raise identically everywhere). Mirrors the
     driver-side fatal-vs-lost classification of
-    exec/bigmachine.go:441-454."""
-    if type(e).__name__ == "XlaRuntimeError":
-        return True
-    text = repr(e).lower()
-    # Multi-word/runtime-specific markers only (the _DIST_ERR_MARKERS
-    # rationale): a user ValueError("roadmap...") must not match "dma".
-    return any(m in text for m in (
-        "resource_exhausted", "out of memory", "device halted",
-        "dma error", "dma failed", "dma timed out",
-        "program fingerprint mismatch",
-    ))
+    exec/bigmachine.go:441-454. Exception TYPE first (XlaRuntimeError
+    anywhere in the chain, subclasses included); the substring scan is
+    the fallback for backends that stringify their runtime errors."""
+    # contexts=False throughout: an infra error that was CAUGHT AND
+    # HANDLED (wrapper fallback, retry ladder) hangs off __context__
+    # of whatever the handler raised next — that later error is its
+    # own failure and must classify on its own merits. (Typed host
+    # loss differs: a lost gang is never 'handled', so its check keeps
+    # the implicit links.)
+    for err in _exception_chain(e, contexts=False):
+        if _is_infra_error_type(err):
+            return True
+    for err in _exception_chain(e, contexts=False):
+        text = repr(err).lower()
+        # Multi-word/runtime-specific markers only (the
+        # _DIST_ERR_MARKERS rationale): a user ValueError("roadmap...")
+        # must not match "dma".
+        if any(m in text for m in _INFRA_ERR_MARKERS):
+            return True
+    return False
 
 
 class DeviceGroupOutput:
@@ -1367,11 +1434,10 @@ class MeshExecutor:
                 t.set_state(TaskState.WAITING)
                 self.local.submit(t)
         except Exception as e:  # noqa: BLE001
-            from bigslice_tpu.utils.distributed import PeerLostError
-
-            if self.multiprocess and (
-                isinstance(e, PeerLostError) or _looks_like_host_loss(e)
-            ):
+            # Type-first classification over the whole failure chain
+            # (PeerLostError/HostLostError types, then runtime-marker
+            # strings — see _looks_like_host_loss).
+            if self.multiprocess and _looks_like_host_loss(e):
                 e = HostLostError(
                     f"peer process lost during SPMD group "
                     f"{tasks[0].name.op}: restart the driver on every "
@@ -1494,6 +1560,76 @@ class MeshExecutor:
         sess = getattr(self, "session", None)
         return getattr(sess, "telemetry", None)
 
+    def _device_telemetry(self):
+        return getattr(self._telemetry_hub(), "device", None)
+
+    def _obs_program(self, prog, kind: str, key_parts,
+                     task: Optional[Task] = None,
+                     op: Optional[str] = None):
+        """The compile-telemetry seam: wrap a freshly-built jitted
+        program so its first call per input signature is AOT-compiled
+        (recording compile wall time + cost/memory analysis, keyed by
+        op + the repr-stable partition config ``key_parts``) and later
+        calls count as cache hits (utils/devicetelemetry.py). No hub →
+        the raw jit returns untouched (collection is no-op-cheap).
+        Multiprocess SPMD meshes skip too: the AOT argument-sharding
+        bake is per-process state and a per-process fallback would
+        diverge dispatch behavior across the gang."""
+        dev = self._device_telemetry()
+        if dev is None or self.multiprocess:
+            return prog
+        try:
+            if task is not None:
+                op = task.name.op
+                inv = task.name.inv_index
+                key_parts = (key_parts,
+                             getattr(task, "partition_config", None))
+            else:
+                inv = None
+            return dev.instrument(prog, op or kind, inv, kind,
+                                  key_parts)
+        except Exception:
+            return prog
+
+    def _telemetry_hbm(self, task0: Task, wave: int) -> None:
+        """Per-wave device-memory watermark (backend allocator stats;
+        live-array fallback on CPU meshes) — sampled after each wave's
+        compute settles, feeding the hbm% status line and the device
+        summary."""
+        dev = self._device_telemetry()
+        if dev is None:
+            return
+        try:
+            dev.sample_hbm(list(self.mesh.devices.flat),
+                           op=task0.name.op,
+                           inv=task0.name.inv_index, wave=wave)
+        except Exception:
+            pass
+
+    def _telemetry_donation(self, task0: Task, inputs) -> None:
+        """Donation effectiveness for one wave: bytes handed to XLA
+        under donate_argnums (the PR-1 donation seams' owned staged
+        buffers) vs. buffers the runtime actually consumed
+        (``is_deleted`` after dispatch — the backend-honored subset)."""
+        dev = self._device_telemetry()
+        if dev is None or not self._donation_on():
+            return
+        try:
+            expected = aliased = nbuf = nalias = 0
+            for a in self._owned_buffers(inputs):
+                nb = int(getattr(a, "nbytes", 0) or 0)
+                expected += nb
+                nbuf += 1
+                if self._buffer_deleted(a):
+                    aliased += nb
+                    nalias += 1
+            if nbuf:
+                dev.record_donation(task0.name.op,
+                                    task0.name.inv_index,
+                                    expected, aliased, nbuf, nalias)
+        except Exception:
+            pass
+
     def _telemetry_staging(self, task0: Task, wave: int, dur_s: float,
                            exposed_s: float,
                            breakdown: Optional[dict] = None) -> None:
@@ -1523,6 +1659,9 @@ class MeshExecutor:
                                     task0.name.inv_index, wave, dur_s)
         except Exception:
             pass
+        # The wave just settled: its buffers are at their liveliest —
+        # the honest moment for the per-wave HBM watermark.
+        self._telemetry_hbm(task0, wave)
 
     def _record_shuffle(self, task0: Task, out) -> None:
         """Per-device output sizes of a partitioned (shuffle-boundary)
@@ -1885,6 +2024,12 @@ class MeshExecutor:
             out_specs=(P(axis), tuple(P(axis) for _ in range(ncols))),
             check_rep=False,
         ))
+        # Kind-level attribution on purpose: this program is cached by
+        # SHAPE and shared by every op with matching (dtypes, cap, B) —
+        # attributing it to the first builder's op would mis-credit
+        # later sharers' compiles/hits (same for merge/subid/keyrange;
+        # only _program's group key is op-specific).
+        prog = self._obs_program(prog, "rowslice", (dtypes, cap, B))
         with self._lock:
             self._programs[key] = (prog, ())
             while len(self._programs) > _PROGRAM_CACHE_MAX:
@@ -1953,16 +2098,29 @@ class MeshExecutor:
         return raw, stages, slack
 
     @staticmethod
-    def _inputs_consumed(inputs) -> bool:
-        """Did a (failed) donated attempt consume these staged buffers?"""
+    def _owned_buffers(inputs):
+        """The donation-eligible buffers of a wave's staged inputs:
+        every column plus the counts array of each owned entry
+        (i[0]=cols, i[1]=counts, i[4]=owned) — the ONE place the
+        staged-input tuple layout is spelled for donation purposes
+        (consumed-check and effectiveness accounting both build on
+        it)."""
         for i in inputs:
             if not i[4]:
                 continue
             for a in list(i[0]) + [i[1]]:
-                fn = getattr(a, "is_deleted", None)
-                if fn is not None and fn():
-                    return True
-        return False
+                yield a
+
+    @staticmethod
+    def _buffer_deleted(a) -> bool:
+        fn = getattr(a, "is_deleted", None)
+        return fn is not None and fn()
+
+    @classmethod
+    def _inputs_consumed(cls, inputs) -> bool:
+        """Did a (failed) donated attempt consume these staged buffers?"""
+        return any(cls._buffer_deleted(a)
+                   for a in cls._owned_buffers(inputs))
 
     def _execute_wave_on(self, tasks: List[Task], wave: int,
                          inputs, first=None,
@@ -2071,6 +2229,9 @@ class MeshExecutor:
                 )
             slack = min(slack * 4, full_slack)
             self._slack_memo[_op_base(task0.name.op)] = slack
+        # Donation effectiveness: how much of what this wave handed to
+        # XLA under donate_argnums was actually consumed (aliased).
+        self._telemetry_donation(task0, inputs)
         # Per-device stride of the (front-packed) output buffers —
         # derived from the actual global shape, which is authoritative
         # for every lowering (sort shuffle, dense tables, pass-through).
@@ -2168,6 +2329,11 @@ class MeshExecutor:
                     check_rep=False,
                 ),
                 tuple(range(W * (1 + ncols))) if donate else (),
+            )
+            # Kind-level attribution: shape-keyed shared cache (see
+            # the rowslice note).
+            prog = self._obs_program(
+                prog, "merge", (ncols, caps, dtypes, donate, bool(mc))
             )
             with self._lock:
                 self._programs[key] = (prog, ())
@@ -2267,6 +2433,7 @@ class MeshExecutor:
             body, mesh=self.mesh, in_specs=(P(axis), P(axis)),
             out_specs=P(axis), check_rep=False,
         ))
+        prog = self._obs_program(prog, "subid_count", (W, cap))
         with self._lock:
             self._programs[key] = (prog, ())
             while len(self._programs) > _PROGRAM_CACHE_MAX:
@@ -2329,6 +2496,8 @@ class MeshExecutor:
             + tuple(col for _ in range(W * npay)),
             check_rep=False,
         ))
+        prog = self._obs_program(prog, "subid_split",
+                                 (dtypes, W, cap, capr))
         with self._lock:
             self._programs[key] = (prog, ())
             while len(self._programs) > _PROGRAM_CACHE_MAX:
@@ -2802,6 +2971,8 @@ class MeshExecutor:
                 body, mesh=self.mesh, in_specs=(P(axis), P(axis)),
                 out_specs=P(), check_rep=False,
             ))
+            prog = self._obs_program(prog, "keyrange",
+                                     (int(capacity), bool(has_sub)))
             with self._lock:
                 self._programs[key] = (prog, ())
                 while len(self._programs) > _PROGRAM_CACHE_MAX:
@@ -3431,6 +3602,17 @@ class MeshExecutor:
             shard_map(stepped, mesh=self.mesh, in_specs=in_specs,
                       out_specs=out_specs, check_rep=False),
             tuple(donate_argnums),
+        )
+        # Compile-telemetry seam: the op's SPMD group program, keyed by
+        # the repr-stable half of the cache key (stage kinds, caps,
+        # partition config, slack/subid/donate signature) — the shape
+        # the future AOT program cache will key on.
+        prog = self._obs_program(
+            prog, "group",
+            (tuple(k for k, _, _ in stages), caps,
+             task.num_partition, self._input_ncols(task), slack,
+             subids, donate),
+            task=task,
         )
         import weakref
 

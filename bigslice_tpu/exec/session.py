@@ -283,10 +283,20 @@ class Session:
         # shuffle/staging seams; queried via telemetry_summary(), the
         # status display's annotations, and /debug/metrics. Its compact
         # skew/overlap instants ride self._event into the Chrome trace
-        # for tools/slicetrace.py.
-        from bigslice_tpu.utils import telemetry as telemetry_mod
+        # for tools/slicetrace.py. BIGSLICE_TELEMETRY=0 disables the
+        # hub entirely (every executor seam no-ops on the missing hub)
+        # — the overhead floor for perf A/Bs of the collection itself.
+        import os
 
-        self.telemetry = telemetry_mod.TelemetryHub(eventer=self._event)
+        self.telemetry = None
+        if os.environ.get("BIGSLICE_TELEMETRY", "1").lower() not in (
+            "0", "false", "off"
+        ):
+            from bigslice_tpu.utils import telemetry as telemetry_mod
+
+            self.telemetry = telemetry_mod.TelemetryHub(
+                eventer=self._event
+            )
         self.status = status_mod.Status()
         self.status.set_telemetry(self.telemetry)
         stats_fn = getattr(self.executor, "resource_stats", None)
@@ -308,13 +318,28 @@ class Session:
             from bigslice_tpu.utils.debughttp import DebugServer
 
             self.debug = DebugServer(self, debug_port)
-        # XLA-level profiling (SURVEY.md §5.1 mapping): every run's
-        # evaluation is wrapped in a jax.profiler trace, producing
-        # XPlane files under xprof_dir (one trace per run) for
-        # TensorBoard/xprof — kernel-level timing to complement the
-        # task-level Chrome trace (trace_path).
+        # XLA-level profiling (SURVEY.md §5.1 mapping), now windowed
+        # and on-demand (utils/xprof.py): /debug/profile?seconds=N on
+        # the DebugServer traces a live session's next N seconds with
+        # no restart. The ``xprof_dir`` spelling (kwarg or the
+        # BIGSLICE_XPROF_DIR env var) is DEPRECATED but kept working —
+        # it now means "profile every evaluation into this dir",
+        # reimplemented through the same single-profiler gate.
+        from bigslice_tpu.utils import xprof as xprof_mod
+
+        if xprof_dir is None:
+            xprof_dir = os.environ.get("BIGSLICE_XPROF_DIR") or None
+        if xprof_dir:
+            import logging
+
+            logging.getLogger("bigslice.session").info(
+                "xprof_dir is deprecated: every evaluation will be "
+                "profiled into %s; prefer the on-demand "
+                "/debug/profile?seconds=N window (docs/observability"
+                ".md, Device plane)", xprof_dir,
+            )
         self.xprof_dir = xprof_dir
-        self._xprof_lock = threading.Lock()
+        self.profiler = xprof_mod.Profiler(every_run_dir=xprof_dir)
         # Slice/callable runs draw from the SAME process-global counter
         # as Func invocations (ops/func._invocation_counter): two
         # counters would collide on index, merging distinct invocations
@@ -395,34 +420,19 @@ class Session:
             attempts = 0
             while True:
                 run_token = self._plan_run(tasks)
-                xprof = None
+                # Deprecated profile-every-evaluation mode: one active
+                # trace at a time (concurrent runs — and /debug/profile
+                # windows — skip), start/stop failures never fail the
+                # run (utils/xprof.Profiler holds the gate).
+                xprof = self.profiler.trace_run()
                 err = None
                 try:
-                    if (self.xprof_dir
-                            and self._xprof_lock.acquire(blocking=False)):
-                        # One active XPlane trace at a time (concurrent
-                        # runs skip). Profiler failures (unwritable dir,
-                        # another live profiler) must not leak the gate
-                        # or the lock.
-                        try:
-                            import jax
-
-                            xprof = jax.profiler.trace(self.xprof_dir)
-                            xprof.__enter__()
-                        except Exception:
-                            xprof = None
-                            self._xprof_lock.release()
                     evaluate(self.executor, tasks, monitor=self.monitor)
                 except Exception as e:  # noqa: BLE001
                     err = e
                 finally:
                     if xprof is not None:
-                        try:
-                            xprof.__exit__(None, None, None)
-                        except Exception:
-                            pass
-                        finally:
-                            self._xprof_lock.release()
+                        xprof.close()
                     # finish_run BEFORE the retry decision: it flushes
                     # an aborted run's parked tasks to the fallback so
                     # they settle (the recover step waits for them).
@@ -449,6 +459,12 @@ class Session:
                         release(tasks)
                     break
                 if attempts >= self.elastic or not _is_gang_loss(err):
+                    # Fatal for this run: dump the flight recorder's
+                    # event ring beside the raise so the post-mortem
+                    # has the last thing every wave/compile/recovery
+                    # channel saw (no-op unless BIGSLICE_FLIGHTREC_DIR
+                    # or an explicit dir is configured).
+                    self._dump_flight(inv_index, err)
                     raise err
                 # Bounded exponential backoff + jitter between elastic
                 # rounds: a just-died mesh re-probed instantly tends to
@@ -477,6 +493,7 @@ class Session:
                         self._gate.release(True)
                         self._gate.acquire(False)
                 if not recovered:
+                    self._dump_flight(inv_index, err)
                     raise err
                 attempts += 1
         finally:
@@ -555,14 +572,36 @@ class Session:
         self._event("bigslice:elasticRetry", cause=repr(cause))
         return True
 
+    def _dump_flight(self, inv_index, err) -> None:
+        """Best-effort flight-recorder dump on a fatal run outcome
+        (utils/telemetry.py dump_flight_record; opt-in via
+        BIGSLICE_FLIGHTREC_DIR)."""
+        if self.telemetry is None:
+            return
+        try:
+            path = self.telemetry.dump_flight_record(
+                inv=inv_index, reason=repr(err)
+            )
+            if path:
+                self._event("bigslice:flightRecorder",
+                            inv=inv_index, path=path)
+        except Exception:
+            pass
+
     def telemetry_summary(self) -> dict:
         """The telemetry hub's aggregated signals (utils/telemetry.py):
         per-op task-duration quantiles + stragglers, shuffle-boundary
-        skew (per-shard rows/bytes, max/median ratio, hot shard), and
+        skew (per-shard rows/bytes, max/median ratio, hot shard),
         wave-pipeline overlap accounting (staging vs exposed time,
-        overlap-efficiency). bench.py records this next to throughput
-        so the perf trajectory carries overlap efficiency alongside
-        rows/sec; tests assert skew flagging through it."""
+        overlap-efficiency), and the ``device`` plane (compile/cost/
+        memory attribution, HBM watermarks, donation effectiveness —
+        utils/devicetelemetry.py). bench.py records this next to
+        throughput so the perf trajectory carries overlap efficiency
+        and compile cost alongside rows/sec; tests assert skew flagging
+        through it. Empty when the hub is disabled
+        (BIGSLICE_TELEMETRY=0)."""
+        if self.telemetry is None:
+            return {}
         return self.telemetry.summary()
 
     # Go-flavored alias (Session.Must): raise on error is Python's default.

@@ -33,14 +33,31 @@ from typing import Optional
 from bigslice_tpu.utils.distributed import global_mesh, is_coordinator  # noqa: F401
 
 
-def spmd_session(mesh=None, parallelism: Optional[int] = None, **kwargs):
+def spmd_session(mesh=None, parallelism: Optional[int] = None,
+                 coordinator_debug_port: Optional[int] = None,
+                 **kwargs):
     """A Session over the global multi-host mesh (call after
     jax.distributed initialization; single-process meshes also work —
-    handy for tests)."""
+    handy for tests).
+
+    ``coordinator_debug_port`` starts the DebugServer — and with it the
+    device-plane endpoints (``/debug/device``,
+    ``/debug/profile?seconds=N``) — on the COORDINATOR process only:
+    every process runs this same driver line, so a plain
+    ``debug_port=`` would bind the same port N times on a multi-process
+    host (and profiling windows are per-process anyway; the
+    coordinator's is the one an operator asks for first).
+
+    Note: on multi-process meshes the compile-telemetry AOT seam is
+    off by design (per-process executable state must not diverge gang
+    dispatch); HBM watermarks and donation effectiveness still record
+    from each process's local devices."""
     from bigslice_tpu.exec.meshexec import MeshExecutor
     from bigslice_tpu.exec.session import Session
 
     if mesh is None:
         mesh = global_mesh()
+    if coordinator_debug_port is not None and is_coordinator():
+        kwargs.setdefault("debug_port", coordinator_debug_port)
     ex = MeshExecutor(mesh, fallback_procs=parallelism, spmd=True)
     return Session(executor=ex, **kwargs)

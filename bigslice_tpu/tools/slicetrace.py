@@ -30,7 +30,14 @@ plus the telemetry-hub sections (utils/telemetry.py):
 - ``invN:recovery`` — per op × attributed fault site, lost tasks the
   recovery ladder brought back and the loss→OK latency (from
   ``bigslice:taskRecovered`` instants; the chaos plane's replayable
-  recovery evidence, utils/faultinject.py + tools/chaosslice.py).
+  recovery evidence, utils/faultinject.py + tools/chaosslice.py);
+- ``invN:compile`` — per op, XLA compilations vs instrumented-cache
+  hits, compile wall time, and the cost-analysis FLOPs / bytes
+  accessed (from ``bigslice:compile`` instants — the device plane's
+  compile attribution, utils/devicetelemetry.py);
+- ``invN:device`` — per-wave HBM watermarks (allocator stats, or the
+  live-array fallback on CPU meshes) and per-op donation
+  effectiveness (``bigslice:hbm`` / ``bigslice:donation`` instants).
 
 Traces from older sessions (no ``inv`` task args) fall back to one
 flat all-ops quartile table.
@@ -115,6 +122,9 @@ def _print_inv(out: List[str], inv, summary: dict, tasks: List[dict],
     _print_overlap(out, inv, telem.get("staging", ()),
                    telem.get("runs", ()))
     _print_recovery(out, inv, telem.get("recovery", ()))
+    _print_compile(out, inv, telem.get("compile", ()))
+    _print_device(out, inv, telem.get("hbm", ()),
+                  telem.get("donation", ()))
     out.append("")
 
 
@@ -252,6 +262,69 @@ def _print_recovery(out: List[str], inv, events):
         )
 
 
+def _print_compile(out: List[str], inv, events):
+    """Device-plane compile attribution from bigslice:compile instants
+    (utils/devicetelemetry.py): per op, how many XLA compilations, the
+    wall time they cost, and the cost-analysis totals."""
+    agg: Dict[str, dict] = {}
+    for ev in events:
+        a = ev.get("args", {})
+        d = agg.setdefault(a.get("op", "?"), {
+            "n": 0, "ms": 0.0, "flops": 0.0, "bytes": 0.0,
+            "kinds": set(),
+        })
+        d["n"] += 1
+        d["ms"] += a.get("ms", 0.0) or 0.0
+        d["flops"] += a.get("flops", 0.0) or 0.0
+        d["bytes"] += a.get("bytes_accessed", 0.0) or 0.0
+        if a.get("kind"):
+            d["kinds"].add(a["kind"])
+    if not agg:
+        return
+    out.append(f"# inv{inv}:compile (XLA compilations, cost analysis)")
+    out.append(f"  {'op':<28} {'n':>4} {'wall_ms':>10} {'mflops':>9} "
+               f"{'MB_acc':>8}  kinds")
+    for op, d in sorted(agg.items()):
+        out.append(
+            f"  {op[:28]:<28} {d['n']:>4} {d['ms']:>10.1f} "
+            f"{d['flops'] / 1e6:>9.2f} {d['bytes'] / 1e6:>8.2f}  "
+            f"{','.join(sorted(d['kinds'])) or '-'}"
+        )
+
+
+def _print_device(out: List[str], inv, hbm, donation):
+    """Per-wave HBM watermarks and donation effectiveness from
+    bigslice:hbm / bigslice:donation instants."""
+    if hbm:
+        out.append(f"# inv{inv}:device (per-wave HBM watermark)")
+        out.append(f"  {'op':<28} {'wave':>4} {'in_use_MB':>10} "
+                   f"{'peak_MB':>8} {'of_limit':>8}")
+        for ev in hbm[-16:]:
+            a = ev.get("args", {})
+            frac = a.get("frac")
+            out.append(
+                f"  {str(a.get('op', '?'))[:28]:<28} "
+                f"{a.get('wave', -1):>4} "
+                f"{(a.get('bytes_in_use', 0) or 0) / 1e6:>10.1f} "
+                f"{(a.get('peak_bytes', 0) or 0) / 1e6:>8.1f} "
+                f"{format(frac, '>7.1%') if frac is not None else '      ?'}"
+            )
+    if donation:
+        agg: Dict[str, List[float]] = {}
+        for ev in donation:
+            a = ev.get("args", {})
+            d = agg.setdefault(a.get("op", "?"), [0.0, 0.0])
+            d[0] += a.get("expected_bytes", 0) or 0
+            d[1] += a.get("aliased_bytes", 0) or 0
+        out.append(f"# inv{inv}:device:donation (donated vs aliased)")
+        out.append(f"  {'op':<28} {'donated_MB':>11} {'aliased_MB':>11} "
+                   f"{'eff':>6}")
+        for op, (exp, ali) in sorted(agg.items()):
+            eff = ali / exp if exp else 0.0
+            out.append(f"  {op[:28]:<28} {exp / 1e6:>11.2f} "
+                       f"{ali / 1e6:>11.2f} {eff:>5.1%}")
+
+
 def analyze(path: str) -> str:
     with open(path) as fp:
         doc = json.load(fp)
@@ -263,6 +336,9 @@ def analyze(path: str) -> str:
         "bigslice:waveStaging": "staging",
         "bigslice:waveRun": "runs",
         "bigslice:taskRecovered": "recovery",
+        "bigslice:compile": "compile",
+        "bigslice:hbm": "hbm",
+        "bigslice:donation": "donation",
     }
     n_tasks = n_instants = 0
     for ev in doc.get("traceEvents", []):

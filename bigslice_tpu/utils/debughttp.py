@@ -4,11 +4,15 @@ Mirrors the reference's debug endpoints (exec/graph.go:15-100,
 exec/session.go:376-389): ``/debug`` (index), ``/debug/status`` (live
 per-op task counts), ``/debug/tasks`` (task DAG as JSON, the d3
 force-graph data source), ``/debug/trace`` (Chrome trace JSON of the
-session so far), ``/debug/resources`` (executor resource gauges), and
+session so far), ``/debug/resources`` (executor resource gauges),
 ``/debug/metrics`` (the telemetry hub's signals in Prometheus text
 exposition format — task-state counters, per-op skew ratio and
 duration quantiles, wave overlap-efficiency gauges — for scrape-based
-production monitoring).
+production monitoring), ``/debug/device`` (the device-plane summary:
+compile/cost/memory attribution, HBM watermarks, donation
+effectiveness), and ``/debug/profile?seconds=N`` (a windowed on-demand
+``jax.profiler`` trace of the live session — the replacement for the
+session-long ``xprof_dir`` hook).
 """
 
 from __future__ import annotations
@@ -17,6 +21,7 @@ import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
+from urllib.parse import parse_qs, urlparse
 
 
 class DebugServer:
@@ -32,7 +37,9 @@ class DebugServer:
                 pass
 
             def do_GET(self):
-                if self.path in ("/debug", "/debug/"):
+                parsed = urlparse(self.path)
+                path = parsed.path
+                if path in ("/debug", "/debug/"):
                     body = (
                         "bigslice_tpu debug\n\n"
                         "/debug/status  live task-state counts\n"
@@ -42,34 +49,77 @@ class DebugServer:
                         "(json)\n"
                         "/debug/metrics  telemetry in Prometheus text "
                         "format\n"
+                        "/debug/device  device-plane summary: compile/"
+                        "cost/memory, HBM, donation (json)\n"
+                        "/debug/profile?seconds=N  windowed jax "
+                        "profiler trace of the live session (json)\n"
                     )
                     self._send(200, "text/plain", body)
-                elif self.path == "/debug/status":
+                elif path == "/debug/status":
                     self._send(200, "text/plain",
                                server.session.status.render() or "(idle)")
-                elif self.path == "/debug/tasks":
+                elif path == "/debug/tasks":
                     self._send(200, "application/json",
                                json.dumps(server.task_graph()))
-                elif self.path == "/debug/resources":
+                elif path == "/debug/resources":
                     stats_fn = getattr(
                         server.session.executor, "resource_stats", None
                     )
                     stats = stats_fn() if stats_fn is not None else {}
                     self._send(200, "application/json",
                                json.dumps(stats))
-                elif self.path == "/debug/metrics":
+                elif path == "/debug/metrics":
                     hub = getattr(server.session, "telemetry", None)
                     text = hub.prometheus_text() if hub else ""
                     self._send(
                         200, "text/plain; version=0.0.4", text
                     )
-                elif self.path == "/debug/trace":
+                elif path == "/debug/device":
+                    hub = getattr(server.session, "telemetry", None)
+                    dev = getattr(hub, "device", None)
+                    doc = dev.summary() if dev is not None else {}
+                    self._send(200, "application/json",
+                               json.dumps(doc, default=str))
+                elif path == "/debug/profile":
+                    self._profile(parse_qs(parsed.query))
+                elif path == "/debug/trace":
                     tracer = server.session.tracer
                     events = tracer.events() if tracer else []
                     self._send(200, "application/json",
                                json.dumps({"traceEvents": events}))
                 else:
                     self._send(404, "text/plain", "not found\n")
+
+            def _profile(self, query):
+                """Windowed on-demand profiling: blocks this request
+                thread for the window (the server is threading, other
+                endpoints stay live), responds with the trace dir +
+                files. 409 when another window/evaluation trace holds
+                the per-process profiler."""
+                from bigslice_tpu.utils.xprof import ProfilerBusy
+
+                profiler = getattr(server.session, "profiler", None)
+                if profiler is None:
+                    self._send(404, "text/plain",
+                               "no profiler on this session\n")
+                    return
+                try:
+                    seconds = float(query.get("seconds", ["1"])[0])
+                except (TypeError, ValueError):
+                    self._send(400, "text/plain",
+                               "seconds must be a number\n")
+                    return
+                try:
+                    result = profiler.window(seconds)
+                except ProfilerBusy as e:
+                    self._send(409, "text/plain", f"{e}\n")
+                    return
+                except Exception as e:  # noqa: BLE001 — report, not 500-crash
+                    self._send(500, "text/plain",
+                               f"profiling failed: {e!r}\n")
+                    return
+                self._send(200, "application/json",
+                           json.dumps(result))
 
             def _send(self, code, ctype, body: str):
                 data = body.encode()

@@ -1,0 +1,572 @@
+"""Device-plane telemetry: XLA compile/cost/memory attribution, HBM
+watermarks, donation effectiveness.
+
+PR 2's hub made the *host* plane visible (skew, stragglers, wave
+overlap); this module is the *device* half the telemetry hub carries as
+``hub.device``:
+
+1. **Compile telemetry** — a seam around every jitted SPMD program the
+   mesh executor builds (`_InstrumentedProgram`): the first call per
+   input signature is compiled ahead-of-time (``jit.lower().compile()``
+   — the exact path tools/aotcheck.py proves on TPU topologies),
+   recording compile wall time, ``cost_analysis()`` (FLOPs / bytes
+   accessed) and ``memory_analysis()`` (argument / output / temp /
+   alias bytes) keyed by op + partition config — the digest that will
+   key ROADMAP item 3's AOT compiled-program cache. Subsequent calls
+   reuse the held executable and count as cache hits, so per-op
+   hit/miss ratios fall out of the call accounting itself (no extra
+   bookkeeping at the executor's program-cache sites).
+2. **HBM accounting** — per-wave device-memory watermarks from the
+   backend allocator (``device.memory_stats()``; real on TPU/GPU) with
+   a ``jax.live_arrays()`` byte-sum fallback where the backend reports
+   nothing (virtual CPU meshes), plus donation effectiveness: bytes
+   the executor *expected* to alias through the PR-1 donation seams
+   vs. buffers the runtime actually consumed.
+
+Everything is exception-safe and cheap by construction: when no hub is
+attached the executor never wraps a program (collection is a no-op),
+and an attached recorder costs one signature tuple per program call.
+The hub surfaces this module's ``summary()`` as
+``Session.telemetry_summary()["device"]``, its ``prometheus_lines()``
+under ``/debug/metrics``, and its instant events as the
+``invN:compile`` / ``invN:device`` slicetrace sections.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from typing import Dict, List, Optional
+
+# Per-wrapper AOT executables held (input signatures per program). The
+# executor's program cache already bounds programs FIFO; this bounds
+# pathological per-program signature churn (shouldn't happen — shapes
+# are part of the executor's cache key — but a leak here would pin
+# compiled executables).
+MAX_SIGNATURES = 8
+
+# Per-op compiled-program detail entries retained in the summary
+# (aggregate counters keep counting past the bound).
+MAX_PROGRAMS_PER_OP = 32
+
+# Retained per-op records (the hub's MAX_OPS rationale: iterative
+# drivers mint fresh #N-suffixed ops each invocation).
+MAX_OPS = 1024
+
+# Per-wave HBM watermark samples retained for the summary (rollup
+# max/peak keeps accumulating past the bound).
+MAX_HBM_SAMPLES = 256
+
+
+def program_digest(op: str, kind: str, parts) -> str:
+    """Stable digest of (op site, program kind, partition/shape
+    config) — the forward-compatible cache key shape for ROADMAP item
+    3's AOT compiled-program cache (registry digest + partition
+    config). ``parts`` must be repr-stable (no ids)."""
+    payload = repr((op, kind, parts)).encode()
+    return hashlib.sha1(payload).hexdigest()[:16]
+
+
+def _cost_dict(compiled) -> dict:
+    """Normalized subset of ``compiled.cost_analysis()`` (which returns
+    a dict or a 1-list of dicts depending on jax version)."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return {}
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    ca = ca or {}
+    out = {}
+    for src, dst in (("flops", "flops"),
+                     ("bytes accessed", "bytes_accessed"),
+                     ("optimal_seconds", "optimal_seconds")):
+        v = ca.get(src)
+        if v is not None:
+            out[dst] = float(v)
+    return out
+
+
+def _memory_dict(compiled) -> dict:
+    """Normalized subset of ``compiled.memory_analysis()`` (None /
+    unimplemented on some backends — callers treat {} as 'unknown')."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return {}
+    if ma is None:
+        return {}
+    out = {}
+    for attr, dst in (("argument_size_in_bytes", "argument_bytes"),
+                      ("output_size_in_bytes", "output_bytes"),
+                      ("temp_size_in_bytes", "temp_bytes"),
+                      ("alias_size_in_bytes", "alias_bytes"),
+                      ("generated_code_size_in_bytes", "code_bytes")):
+        v = getattr(ma, attr, None)
+        if v is not None:
+            out[dst] = int(v)
+    return out
+
+
+def _arg_signature(a) -> tuple:
+    """Cheap per-argument identity for the executable cache: shape,
+    dtype, and — for committed device arrays — the sharding (hashable
+    on jax shardings; a numpy host arg and a mesh-sharded device arg
+    must not share an AOT executable, whose input shardings are baked
+    at compile time)."""
+    shape = getattr(a, "shape", None)
+    if shape is None:
+        return (type(a).__name__, repr(a))
+    dtype = str(getattr(a, "dtype", ""))
+    sharding = getattr(a, "sharding", None)
+    if sharding is not None:
+        try:
+            hash(sharding)  # signatures are dict keys downstream
+            return (shape, dtype, sharding)
+        except Exception:  # unhashable exotic sharding: coarse tag
+            return (shape, dtype, "sharded")
+    return (shape, dtype)
+
+
+class _InstrumentedProgram:
+    """Transparent wrapper over a jitted program: ahead-of-time
+    compiles on first call per input signature (recording wall time +
+    cost/memory analysis into the recorder), reuses the held executable
+    after (recording cache hits). Any AOT-path surprise — an argument
+    aval/sharding the baked executable rejects, an ancient jax without
+    the AOT API — permanently falls back to the plain jitted callable
+    for this wrapper (correctness never depends on instrumentation).
+
+    Argument-compatibility errors raise *before* execution (donated
+    buffers are not yet consumed), so the fallback re-call is safe; a
+    genuine runtime failure (OOM, DMA) re-raises unchanged into the
+    executor's classification ladder."""
+
+    __slots__ = ("_fn", "_rec", "_op", "_inv", "_kind", "_digest",
+                 "_compiled", "_fell_back", "_lock")
+
+    def __init__(self, fn, recorder: "DeviceTelemetry", op: str,
+                 inv: Optional[int], kind: str, digest: str):
+        self._fn = fn
+        self._rec = recorder
+        self._op = op
+        self._inv = inv
+        self._kind = kind
+        self._digest = digest
+        self._compiled: Dict[tuple, object] = {}
+        self._fell_back = False
+        # Cached wrapped programs are shared across concurrent group
+        # threads; the probe/compile/bookkeeping must not race (two
+        # threads both missing would each pay a multi-second compile —
+        # the raw jit this wraps serializes compilation internally).
+        # Held only around probe + compile, never around execution.
+        self._lock = threading.Lock()
+
+    # The executor's retry ladder re-enters with identical shapes;
+    # expose lower for anything that held the raw jit before.
+    def lower(self, *args, **kw):
+        return self._fn.lower(*args, **kw)
+
+    def __call__(self, *args):
+        with self._lock:
+            if self._fell_back:
+                compiled = None
+            else:
+                try:
+                    # Signature build AND cache probe both inside the
+                    # guard: a signature that defeats the hashability
+                    # probe must fall back, never crash the wave.
+                    sig = tuple(_arg_signature(a) for a in args)
+                    compiled = self._compiled.get(sig)
+                except Exception:
+                    compiled = None
+                    self._fall_back_locked()
+                if compiled is None and not self._fell_back:
+                    if len(self._compiled) >= MAX_SIGNATURES:
+                        # Signature churn the executor's cache key
+                        # should have prevented: stop holding
+                        # executables, keep running.
+                        self._fall_back_locked()
+                    else:
+                        t0 = time.perf_counter()
+                        try:
+                            compiled = self._fn.lower(*args).compile()
+                        except Exception:
+                            # No AOT API / lowering quirk: plain jit
+                            # from here on.
+                            self._fall_back_locked()
+                        else:
+                            wall = time.perf_counter() - t0
+                            self._rec.record_compile(
+                                self._op, self._inv, self._kind,
+                                self._digest, wall,
+                                cost=_cost_dict(compiled),
+                                memory=_memory_dict(compiled),
+                            )
+                            self._compiled[sig] = compiled
+                elif compiled is not None:
+                    self._rec.record_cache_hit(self._op, self._inv,
+                                               self._kind)
+        if compiled is None:
+            return self._fn(*args)
+        try:
+            return compiled(*args)
+        except (TypeError, ValueError):
+            # Baked-executable argument rejection (aval/sharding/layout
+            # mismatch our signature missed) — raised before execution,
+            # args intact: run the flexible jit path instead, for good.
+            with self._lock:
+                self._fall_back_locked()
+            return self._fn(*args)
+
+    def _fall_back_locked(self) -> None:
+        """Permanently route this wrapper to the plain jit, releasing
+        every held executable (a fallen-back wrapper must not pin AOT
+        programs the jit path will recompile on its own)."""
+        self._fell_back = True
+        self._compiled.clear()
+
+
+class _OpDeviceRecord:
+    def __init__(self, inv: Optional[int] = None):
+        self.inv = inv
+        self.compiles = 0
+        self.cache_hits = 0
+        self.compile_wall_s = 0.0
+        self.flops = 0.0
+        self.bytes_accessed = 0.0
+        self.programs: List[dict] = []
+        # donation effectiveness
+        self.donation_expected_bytes = 0
+        self.donation_aliased_bytes = 0
+        self.donation_buffers = 0
+        self.donation_aliased_buffers = 0
+
+
+class DeviceTelemetry:
+    """The device-plane recorder the telemetry hub owns (``hub.device``).
+    All entry points are lock-protected, exception-safe, and O(1)."""
+
+    def __init__(self, eventer=None):
+        self._lock = threading.Lock()
+        self._ops: Dict[str, _OpDeviceRecord] = {}
+        self._hbm: List[dict] = []
+        self._hbm_peak_bytes = 0
+        self._hbm_limit_bytes: Optional[int] = None
+        self._hbm_source: Optional[str] = None
+        self._eventer = eventer
+
+    def _emit(self, name: str, **fields) -> None:
+        ev = self._eventer
+        if ev is None:
+            return
+        try:
+            ev(name, **fields)
+        except Exception:  # telemetry must never break the run
+            pass
+
+    def _op(self, op: str, inv: Optional[int]) -> _OpDeviceRecord:
+        rec = self._ops.get(op)
+        if rec is None:
+            while len(self._ops) >= MAX_OPS:
+                del self._ops[next(iter(self._ops))]
+            rec = self._ops[op] = _OpDeviceRecord(inv)
+        if rec.inv is None:
+            rec.inv = inv
+        return rec
+
+    # -- the program seam -------------------------------------------------
+
+    def instrument(self, prog, op: str, inv: Optional[int], kind: str,
+                   key_parts) -> _InstrumentedProgram:
+        """Wrap a freshly-built jitted program. ``kind`` names the
+        program family (``group`` for the op's SPMD program, or the
+        auxiliary ``rowslice``/``merge``/``subid_count``/``subid_split``
+        /``keyrange`` helpers); ``key_parts`` is the repr-stable
+        partition/shape config the digest derives from."""
+        return _InstrumentedProgram(
+            prog, self, op, inv, kind,
+            program_digest(op, kind, key_parts),
+        )
+
+    def record_compile(self, op: str, inv: Optional[int], kind: str,
+                       digest: str, wall_s: float,
+                       cost: Optional[dict] = None,
+                       memory: Optional[dict] = None) -> None:
+        wall_s = max(0.0, float(wall_s))
+        cost = cost or {}
+        memory = memory or {}
+        with self._lock:
+            rec = self._op(op, inv)
+            rec.compiles += 1
+            rec.compile_wall_s += wall_s
+            rec.flops += float(cost.get("flops") or 0.0)
+            rec.bytes_accessed += float(cost.get("bytes_accessed")
+                                        or 0.0)
+            if len(rec.programs) < MAX_PROGRAMS_PER_OP:
+                entry = {"kind": kind, "key": digest,
+                         "compile_s": round(wall_s, 6)}
+                entry.update({k: v for k, v in cost.items()})
+                entry.update({k: v for k, v in memory.items()})
+                rec.programs.append(entry)
+        self._emit("bigslice:compile", op=op, inv=inv, kind=kind,
+                   key=digest, ms=round(wall_s * 1e3, 3),
+                   flops=cost.get("flops"),
+                   bytes_accessed=cost.get("bytes_accessed"),
+                   temp_bytes=memory.get("temp_bytes"),
+                   arg_bytes=memory.get("argument_bytes"),
+                   out_bytes=memory.get("output_bytes"))
+
+    def record_cache_hit(self, op: str, inv: Optional[int],
+                         kind: str) -> None:
+        with self._lock:
+            self._op(op, inv).cache_hits += 1
+
+    # -- HBM watermarks ---------------------------------------------------
+
+    def sample_hbm(self, devices, op: Optional[str] = None,
+                   inv: Optional[int] = None,
+                   wave: Optional[int] = None) -> Optional[dict]:
+        """One device-memory watermark sample: the backend allocator's
+        ``memory_stats()`` where it reports (TPU/GPU), else the
+        ``jax.live_arrays()`` byte sum (virtual CPU meshes report no
+        allocator stats; the fallback must not raise — the CPU-backend
+        contract the tests pin). Returns the recorded sample."""
+        in_use = peak = 0
+        limit: Optional[int] = None
+        source = "memory_stats"
+        got = False
+        try:
+            for d in devices:
+                try:
+                    stats = d.memory_stats()
+                except Exception:
+                    stats = None
+                if not stats:
+                    continue
+                got = True
+                in_use = max(in_use, int(stats.get("bytes_in_use")
+                                         or 0))
+                peak = max(peak, int(stats.get("peak_bytes_in_use")
+                                     or stats.get("bytes_in_use")
+                                     or 0))
+                lim = stats.get("bytes_limit")
+                if lim:
+                    limit = max(limit or 0, int(lim))
+            if not got:
+                source = "live_arrays"
+                import jax
+
+                in_use = sum(
+                    int(getattr(a, "nbytes", 0) or 0)
+                    for a in jax.live_arrays()
+                )
+                peak = in_use
+        except Exception:
+            return None
+        return self.record_hbm(in_use, peak, limit, source=source,
+                               op=op, inv=inv, wave=wave)
+
+    def record_hbm(self, bytes_in_use: int, peak_bytes: int,
+                   limit_bytes: Optional[int], source: str = "",
+                   op: Optional[str] = None, inv: Optional[int] = None,
+                   wave: Optional[int] = None) -> dict:
+        sample = {
+            "bytes_in_use": int(bytes_in_use),
+            "peak_bytes": int(max(peak_bytes, bytes_in_use)),
+        }
+        if op is not None:
+            sample["op"] = op
+        if wave is not None:
+            sample["wave"] = int(wave)
+        if limit_bytes:
+            sample["limit_bytes"] = int(limit_bytes)
+            sample["frac"] = round(
+                sample["bytes_in_use"] / int(limit_bytes), 4
+            )
+        with self._lock:
+            self._hbm_peak_bytes = max(self._hbm_peak_bytes,
+                                       sample["peak_bytes"])
+            if limit_bytes:
+                self._hbm_limit_bytes = max(
+                    self._hbm_limit_bytes or 0, int(limit_bytes)
+                )
+            if source:
+                self._hbm_source = source
+            self._hbm.append(sample)
+            if len(self._hbm) > MAX_HBM_SAMPLES:
+                del self._hbm[0]
+        self._emit("bigslice:hbm", op=op, inv=inv, wave=wave,
+                   bytes_in_use=sample["bytes_in_use"],
+                   peak_bytes=sample["peak_bytes"],
+                   limit_bytes=sample.get("limit_bytes"),
+                   frac=sample.get("frac"))
+        return sample
+
+    # -- donation effectiveness -------------------------------------------
+
+    def record_donation(self, op: str, inv: Optional[int],
+                        expected_bytes: int, aliased_bytes: int,
+                        buffers: int = 0,
+                        aliased_buffers: int = 0) -> None:
+        """One wave's donation outcome: bytes the executor handed to
+        XLA under donate_argnums (expected to alias) vs. bytes whose
+        buffers the runtime actually consumed (``is_deleted`` after
+        dispatch — the backend-honored subset)."""
+        with self._lock:
+            rec = self._op(op, inv)
+            rec.donation_expected_bytes += max(0, int(expected_bytes))
+            rec.donation_aliased_bytes += max(0, int(aliased_bytes))
+            rec.donation_buffers += max(0, int(buffers))
+            rec.donation_aliased_buffers += max(0, int(aliased_buffers))
+        self._emit("bigslice:donation", op=op, inv=inv,
+                   expected_bytes=int(expected_bytes),
+                   aliased_bytes=int(aliased_bytes))
+
+    # -- queries ----------------------------------------------------------
+
+    def status_line(self) -> Optional[str]:
+        """The live ``hbm %`` annotation for the status display."""
+        with self._lock:
+            if not self._hbm:
+                return None
+            cur = self._hbm[-1]
+            peak = self._hbm_peak_bytes
+            limit = self._hbm_limit_bytes
+        mb = cur["bytes_in_use"] / 1e6
+        if limit:
+            return (f"  hbm {100.0 * cur['bytes_in_use'] / limit:.0f}%"
+                    f" in use ({mb:.0f}MB,"
+                    f" peak {100.0 * peak / limit:.0f}%)")
+        return f"  device mem {mb:.0f}MB in use (no allocator limit)"
+
+    def summary(self) -> dict:
+        """The ``telemetry_summary()["device"]`` payload."""
+        with self._lock:
+            compile_ops = {}
+            tot_compiles = tot_hits = 0
+            tot_wall = tot_flops = tot_bytes = 0.0
+            donation = {}
+            don_expected = don_aliased = 0
+            for op, rec in self._ops.items():
+                if rec.compiles or rec.cache_hits:
+                    compile_ops[op] = {
+                        "inv": rec.inv,
+                        "compiles": rec.compiles,
+                        "cache_hits": rec.cache_hits,
+                        "compile_s": round(rec.compile_wall_s, 6),
+                        "flops": rec.flops,
+                        "bytes_accessed": rec.bytes_accessed,
+                        "programs": list(rec.programs),
+                    }
+                    tot_compiles += rec.compiles
+                    tot_hits += rec.cache_hits
+                    tot_wall += rec.compile_wall_s
+                    tot_flops += rec.flops
+                    tot_bytes += rec.bytes_accessed
+                if rec.donation_buffers:
+                    eff = (rec.donation_aliased_bytes
+                           / rec.donation_expected_bytes
+                           if rec.donation_expected_bytes else 0.0)
+                    donation[op] = {
+                        "expected_bytes": rec.donation_expected_bytes,
+                        "aliased_bytes": rec.donation_aliased_bytes,
+                        "buffers": rec.donation_buffers,
+                        "aliased_buffers": rec.donation_aliased_buffers,
+                        "effectiveness": round(eff, 4),
+                    }
+                    don_expected += rec.donation_expected_bytes
+                    don_aliased += rec.donation_aliased_bytes
+            hbm: dict = {}
+            if self._hbm:
+                hbm = {
+                    "samples": len(self._hbm),
+                    "source": self._hbm_source,
+                    "current_bytes": self._hbm[-1]["bytes_in_use"],
+                    "peak_bytes": self._hbm_peak_bytes,
+                    "per_wave": list(self._hbm[-32:]),
+                }
+                if self._hbm_limit_bytes:
+                    hbm["limit_bytes"] = self._hbm_limit_bytes
+                    hbm["peak_frac"] = round(
+                        self._hbm_peak_bytes / self._hbm_limit_bytes, 4
+                    )
+        out = {
+            "compile": compile_ops,
+            "hbm": hbm,
+            "donation": donation,
+            "totals": {
+                "compiles": tot_compiles,
+                "cache_hits": tot_hits,
+                "compile_s": round(tot_wall, 6),
+                "flops": tot_flops,
+                "bytes_accessed": tot_bytes,
+                "hbm_peak_bytes": self._hbm_peak_bytes,
+                "donation_effectiveness": round(
+                    don_aliased / don_expected, 4
+                ) if don_expected else None,
+            },
+        }
+        return out
+
+    def prometheus_lines(self, metric, line) -> None:
+        """Append this recorder's gauges/counters through the hub's
+        Prometheus helpers (metric(name, help, type) / line(name,
+        labels, value))."""
+        with self._lock:
+            ops = dict(self._ops)
+            hbm_last = self._hbm[-1] if self._hbm else None
+            hbm_peak = self._hbm_peak_bytes
+            hbm_limit = self._hbm_limit_bytes
+        metric("bigslice_compile_total",
+               "XLA program compilations and instrumented-cache hits "
+               "per op.", "counter")
+        for op, rec in ops.items():
+            if rec.compiles:
+                line("bigslice_compile_total",
+                     {"op": op, "result": "compile"}, rec.compiles)
+            if rec.cache_hits:
+                line("bigslice_compile_total",
+                     {"op": op, "result": "cache_hit"}, rec.cache_hits)
+        metric("bigslice_compile_seconds_total",
+               "Cumulative XLA compile wall time per op.", "counter")
+        for op, rec in ops.items():
+            if rec.compile_wall_s > 0:
+                line("bigslice_compile_seconds_total", {"op": op},
+                     f"{rec.compile_wall_s:.6f}")
+        metric("bigslice_program_flops_total",
+               "XLA cost-analysis FLOPs of compiled programs per op.",
+               "counter")
+        for op, rec in ops.items():
+            if rec.flops > 0:
+                line("bigslice_program_flops_total", {"op": op},
+                     f"{rec.flops:.0f}")
+        metric("bigslice_program_bytes_accessed_total",
+               "XLA cost-analysis bytes accessed per op.", "counter")
+        for op, rec in ops.items():
+            if rec.bytes_accessed > 0:
+                line("bigslice_program_bytes_accessed_total",
+                     {"op": op}, f"{rec.bytes_accessed:.0f}")
+        metric("bigslice_donation_bytes_total",
+               "Wave-input bytes donated to XLA (expected) vs. "
+               "actually consumed by the runtime (aliased).", "counter")
+        for op, rec in ops.items():
+            if rec.donation_buffers:
+                line("bigslice_donation_bytes_total",
+                     {"op": op, "kind": "expected"},
+                     rec.donation_expected_bytes)
+                line("bigslice_donation_bytes_total",
+                     {"op": op, "kind": "aliased"},
+                     rec.donation_aliased_bytes)
+        if hbm_last is not None:
+            metric("bigslice_hbm_bytes",
+                   "Device-memory watermark (max across devices; "
+                   "live_arrays fallback on backends without "
+                   "allocator stats).", "gauge")
+            line("bigslice_hbm_bytes", {"kind": "in_use"},
+                 hbm_last["bytes_in_use"])
+            line("bigslice_hbm_bytes", {"kind": "peak"}, hbm_peak)
+            if hbm_limit:
+                line("bigslice_hbm_bytes", {"kind": "limit"},
+                     hbm_limit)

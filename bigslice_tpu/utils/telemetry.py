@@ -48,6 +48,7 @@ O(1) per task transition amortized.
 
 from __future__ import annotations
 
+import collections
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
@@ -78,6 +79,12 @@ MAX_OPS = 1024
 # still count — only the latency sample is dropped).
 MAX_RECOVERY_SAMPLES = 4096
 MAX_RECOVERY_PENDING = 4096
+
+# Flight-recorder ring: the last N structured hub events, dumped as a
+# flightrec-<inv>.json artifact on fatal error / drain timeout. Small
+# on purpose: the recorder answers "what was the run doing right
+# before it died", not "replay the whole session".
+FLIGHT_MAX_EVENTS = 512
 
 
 def quantile(sorted_xs: List[float], p: float) -> float:
@@ -163,6 +170,29 @@ class TelemetryHub:
         self._drain_timeouts = 0
         self._drain_wedged: List[dict] = []
         self._eventer = eventer
+        # Flight recorder: every event _emit sends (wave staging/
+        # compute, shuffle sizes, compile, hbm, recovery...) also lands
+        # in this bounded ring; dump_flight_record writes it out on
+        # fatal error / drain timeout when a dump dir is configured.
+        self._flight: collections.deque = collections.deque(
+            maxlen=FLIGHT_MAX_EVENTS
+        )
+        # Own lock (never nests under executor/monitor paths): appends
+        # happen on whatever thread emitted, and the dump snapshot must
+        # not race them — a deque mutated mid-iteration raises, and the
+        # dump's best-effort except would silently eat the one artifact
+        # a live failure exists to leave behind.
+        self._flight_lock = threading.Lock()
+        self._flight_dumped: Dict[object, str] = {}
+        # Device plane (utils/devicetelemetry.py): compile/cost/memory
+        # attribution, HBM watermarks, donation effectiveness. Shares
+        # this hub's eventer so its instants ride the same tracer lane
+        # (and this flight ring).
+        from bigslice_tpu.utils import devicetelemetry
+
+        self.device = devicetelemetry.DeviceTelemetry(
+            eventer=self._emit
+        )
         self.skew_ratio = skew_ratio
         self.skew_min_rows = skew_min_rows
         self.straggler_factor = straggler_factor
@@ -184,6 +214,15 @@ class TelemetryHub:
         return rec
 
     def _emit(self, name: str, **fields) -> None:
+        try:
+            with self._flight_lock:
+                self._flight.append(
+                    (time.time(), name,
+                     {k: v for k, v in fields.items()
+                      if v is not None})
+                )
+        except Exception:
+            pass
         ev = self._eventer
         if ev is None:
             return
@@ -291,6 +330,80 @@ class TelemetryHub:
             self._drain_wedged = list(wedged)[:64]
         self._emit("bigslice:drainTimeout", n=len(wedged),
                    tasks=[w["task"] for w in wedged[:8]])
+        # The drain census IS the wedge evidence a post-mortem needs:
+        # dump the flight ring next to it (no-op unless a dump dir is
+        # configured — see dump_flight_record).
+        self.dump_flight_record(reason="drain_timeout")
+
+    # -- flight recorder --------------------------------------------------
+
+    @staticmethod
+    def flightrec_dir(out_dir: Optional[str] = None) -> Optional[str]:
+        """Where flight-recorder dumps go: explicit arg, else the
+        ``BIGSLICE_FLIGHTREC_DIR`` env var, else None (dumping is
+        opt-in: a failing unit test must not litter /tmp)."""
+        import os
+
+        return out_dir or os.environ.get("BIGSLICE_FLIGHTREC_DIR") \
+            or None
+
+    def dump_flight_record(self, inv: Optional[int] = None,
+                           reason: str = "",
+                           out_dir: Optional[str] = None
+                           ) -> Optional[str]:
+        """Write the event ring (filtered to ``inv`` when given — events
+        with no inv tag ride along) plus the task-state census and the
+        active chaos plan to ``flightrec-<inv>.json``. Best-effort and
+        deduped per inv — matching the one-file-per-inv naming, so a
+        later outcome for the same invocation can never silently
+        overwrite the first dump (whose ring, closest to the original
+        failure, is the evidence a post-mortem wants). Returns the
+        path, or None when no dump dir is configured or writing
+        failed."""
+        dirname = self.flightrec_dir(out_dir)
+        if dirname is None:
+            return None
+        key = inv
+        try:
+            with self._flight_lock:
+                ring = list(self._flight)
+            with self._lock:
+                if key in self._flight_dumped:
+                    return self._flight_dumped[key]
+                events = [
+                    {"ts": ts, "name": name, **fields}
+                    for ts, name, fields in ring
+                    if inv is None or fields.get("inv") in (None, inv)
+                ]
+                states: Dict[str, int] = {}
+                for (_, st), n in self._state_counts.items():
+                    states[st] = states.get(st, 0) + n
+            doc = {
+                "inv": inv,
+                "reason": reason,
+                "ts": time.time(),
+                "task_states": states,
+                "events": events,
+            }
+            plan = faultinject.active_plan()
+            if plan is not None:
+                doc["chaos"] = plan.snapshot()
+            import json
+            import os
+
+            os.makedirs(dirname, exist_ok=True)
+            path = os.path.join(
+                dirname,
+                f"flightrec-{inv if inv is not None else 'session'}"
+                f".json",
+            )
+            with open(path, "w") as fp:
+                json.dump(doc, fp, indent=1, default=str)
+            with self._lock:
+                self._flight_dumped[key] = path
+            return path
+        except Exception:  # telemetry must never break the run
+            return None
 
     # -- executor seams ---------------------------------------------------
 
@@ -520,6 +633,14 @@ class TelemetryHub:
                 "injected": snap["injected"],
                 "by_kind": snap["by_kind"],
             }
+        # Device plane: compile attribution, HBM watermarks, donation
+        # effectiveness (utils/devicetelemetry.py). Always present so
+        # consumers need no existence dance; empty sub-dicts mean "no
+        # device work observed".
+        try:
+            out["device"] = self.device.summary()
+        except Exception:
+            out["device"] = {}
         return out
 
     @staticmethod
@@ -595,6 +716,12 @@ class TelemetryHub:
                 f"  straggler (live) {s['task']}: {s['elapsed_s']:.2f}s"
                 f" vs p50 {s['p50_s']:.2f}s"
             )
+        try:
+            hbm = self.device.status_line()
+            if hbm:
+                lines.append(hbm)
+        except Exception:
+            pass
         return lines
 
     # -- Prometheus export ------------------------------------------------
@@ -773,6 +900,12 @@ class TelemetryHub:
                    "still in flight.", "counter")
             line("bigslice_drain_timeout_total", {},
                  self._drain_timeouts)
+
+        # -- device plane (compile / HBM / donation gauges) -----------
+        try:
+            self.device.prometheus_lines(metric, line)
+        except Exception:
+            pass
 
         plan = faultinject.active_plan()
         if plan is not None:

@@ -57,7 +57,8 @@ def _get(sess, path):
 def test_debug_index_lists_every_endpoint(debug_sess):
     body, _ = _get(debug_sess, "/debug")
     for ep in ("/debug/status", "/debug/tasks", "/debug/trace",
-               "/debug/resources", "/debug/metrics"):
+               "/debug/resources", "/debug/metrics", "/debug/device",
+               "/debug/profile"):
         assert ep in body
 
 
@@ -118,3 +119,76 @@ def test_debug_metrics_prometheus_parseable(debug_sess):
 def test_debug_unknown_path_404(debug_sess):
     with pytest.raises(urllib.error.HTTPError):
         _get(debug_sess, "/nope")
+
+
+# ------------------------------------------------------- device plane
+
+def test_debug_device_endpoint(debug_sess):
+    """Acceptance: /debug/device on a live waved-mesh session returns
+    the device-plane summary JSON — per-op compile attribution with
+    wall time and cache hit/miss counts, plus the HBM watermark section
+    (live-array fallback source on the CPU mesh)."""
+    body, ctype = _get(debug_sess, "/debug/device")
+    assert ctype.startswith("application/json")
+    doc = json.loads(body)
+    assert {"compile", "hbm", "donation", "totals"} <= set(doc)
+    totals = doc["totals"]
+    assert totals["compiles"] > 0
+    assert totals["compile_s"] > 0
+    # Per-op entries carry per-program cost/memory details.
+    ops = doc["compile"]
+    assert ops
+    some = next(iter(ops.values()))
+    assert some["compiles"] >= 1
+    assert some["programs"] and "compile_s" in some["programs"][0]
+    # The waved run sampled per-wave watermarks (CPU → live_arrays).
+    assert doc["hbm"]["samples"] > 0
+    assert doc["hbm"]["peak_bytes"] > 0
+
+
+def test_debug_profile_window(debug_sess, tmp_path):
+    """Acceptance: /debug/profile?seconds=N profiles the live session
+    for the window and returns a loadable trace directory (non-empty
+    xplane/trace artifacts under it)."""
+    import os
+
+    body, ctype = _get(debug_sess, "/debug/profile?seconds=0.2")
+    assert ctype.startswith("application/json")
+    doc = json.loads(body)
+    assert os.path.isdir(doc["dir"])
+    assert doc["files"], f"no trace files under {doc['dir']}"
+    assert any(f.endswith((".xplane.pb", ".trace.json.gz"))
+               for f in doc["files"])
+
+
+def test_debug_profile_bad_seconds_400(debug_sess):
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(debug_sess, "/debug/profile?seconds=nope")
+    assert ei.value.code == 400
+
+
+def test_debug_profile_busy_409(debug_sess):
+    """A second window while one is live gets 409, not a crashed
+    profiler (jax allows one live profiler per process)."""
+    import threading
+
+    errs = []
+
+    def long_window():
+        try:
+            _get(debug_sess, "/debug/profile?seconds=1.5")
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    t = threading.Thread(target=long_window)
+    t.start()
+    try:
+        import time
+
+        time.sleep(0.4)  # let the first window start
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(debug_sess, "/debug/profile?seconds=0.1")
+        assert ei.value.code == 409
+    finally:
+        t.join()
+    assert not errs

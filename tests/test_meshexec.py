@@ -885,3 +885,106 @@ def test_daemon_pool_recycles_and_survives_exceptions():
                 break
         time.sleep(0.01)
     assert done.count(1) == 2
+
+
+# ------------------------------------------- error classification
+
+class _FakeXlaRuntimeError(RuntimeError):
+    """Stands in for jaxlib's XlaRuntimeError: classification matches
+    by type NAME through the MRO, so a same-named class (or subclass)
+    is exactly what the real one looks like to the classifier."""
+
+
+_FakeXlaRuntimeError.__name__ = "XlaRuntimeError"
+
+
+class _XlaSubclass(_FakeXlaRuntimeError):
+    """A subclass keeps matching via the MRO walk (jax wraps the
+    jaxlib type in version-specific shims)."""
+
+
+_XlaSubclass.__name__ = "JaxBackendError"
+
+
+def test_infra_error_classified_by_type():
+    from bigslice_tpu.exec.meshexec import _looks_like_infra_error
+
+    assert _looks_like_infra_error(_FakeXlaRuntimeError("boom"))
+    assert _looks_like_infra_error(_XlaSubclass("wrapped boom"))
+    # ...anywhere in the failure chain, not just at the top: the new
+    # seams (instrumented programs, staging retries) re-raise with
+    # context.
+    try:
+        try:
+            raise _FakeXlaRuntimeError("device died")
+        except _FakeXlaRuntimeError as inner:
+            raise ValueError("wrapper") from inner
+    except ValueError as outer:
+        assert _looks_like_infra_error(outer)
+
+
+def test_infra_error_string_fallback_and_negatives():
+    from bigslice_tpu.exec.meshexec import _looks_like_infra_error
+
+    # Marker-string fallback (backends that stringify runtime errors).
+    assert _looks_like_infra_error(
+        RuntimeError("RESOURCE_EXHAUSTED: while allocating 2G")
+    )
+    assert _looks_like_infra_error(RuntimeError("DMA error on chip 3"))
+    # A user error merely *mentioning* suggestive words must not be
+    # rerouted to the host tier: multi-word markers only.
+    assert not _looks_like_infra_error(
+        ValueError("user asked about dma and memory budgets")
+    )
+    assert not _looks_like_infra_error(ValueError("plain user error"))
+
+
+def test_host_loss_classified_by_type_then_string():
+    from bigslice_tpu.exec.meshexec import (
+        HostLostError,
+        _looks_like_host_loss,
+    )
+    from bigslice_tpu.utils.distributed import PeerLostError
+
+    assert _looks_like_host_loss(PeerLostError("peer 3 gone"))
+    assert _looks_like_host_loss(HostLostError("already wrapped"))
+    # Typed loss buried in an implicit (__context__) chain.
+    try:
+        try:
+            raise PeerLostError("peer lost mid-collective")
+        except PeerLostError:
+            raise RuntimeError("collective failed")
+    except RuntimeError as outer:
+        assert _looks_like_host_loss(outer)
+    # String fallback for opaque runtime errors.
+    assert _looks_like_host_loss(
+        RuntimeError("Gloo allreduce failed: connection reset by peer")
+    )
+    # Mentioning "peer" alone is not a loss.
+    assert not _looks_like_host_loss(
+        ValueError("peer review feedback pending")
+    )
+
+
+def test_exception_chain_is_cycle_safe():
+    from bigslice_tpu.exec.meshexec import _exception_chain
+
+    a = ValueError("a")
+    b = RuntimeError("b")
+    a.__cause__ = b
+    b.__cause__ = a  # pathological cycle must not hang
+    assert {repr(e) for e in _exception_chain(a)} == {repr(a), repr(b)}
+
+
+def test_task_error_cause_is_walked():
+    """TaskError carries its cause on .cause (not __cause__); the
+    classifier must follow it — that's how device errors surface to
+    the session's gang-loss check."""
+    import types
+
+    from bigslice_tpu.exec.meshexec import _looks_like_infra_error
+    from bigslice_tpu.exec.task import TaskError, TaskName
+
+    t = types.SimpleNamespace(name=TaskName(1, "op", 0, 1))
+    err = TaskError(t, _FakeXlaRuntimeError("oom"))
+    assert _looks_like_infra_error(err)
