@@ -65,11 +65,14 @@ def note(msg: str) -> None:
 
 
 def _mesh():
+    # Honors BIGSLICE_MESH_SHAPE=DxI (the 2-D DCN × ICI hierarchy) and
+    # the real-TPU topology probe; unset on a flat fleet this is the
+    # same 1-D ("shards",) mesh every prior bench built.
     import jax
-    from jax.sharding import Mesh
 
-    devs = jax.devices()
-    return Mesh(np.array(devs), ("shards",))
+    from bigslice_tpu.parallel.meshutil import shape_device_mesh
+
+    return shape_device_mesh(jax.devices())
 
 
 def _mesh_session(mesh):
@@ -224,6 +227,40 @@ def reduce_e2e_bench(keys, vals, iters: int = 3, dense_keys=None,
 
 # ----------------------------------------------------------- reduce-wave
 
+def _timed_waved_reduce(sess, keys, vals, num_shards: int, iters: int,
+                        collect_rows: bool = False):
+    """THE warm + best-of-iters protocol shared by the waved keyed-
+    Reduce benches (reduce-wave and reduce-wave-2d): one warm pass for
+    compile caches (and the slack memo), then ``iters`` timed runs.
+    Returns (best_seconds, last_result) where result is the distinct
+    row count, or the sorted result rows when ``collect_rows`` (the
+    2-D A/B's parity evidence)."""
+    import bigslice_tpu as bs
+
+    def add(a, b):
+        return a + b
+
+    def run_once():
+        res = sess.run(bs.Reduce(bs.Const(num_shards, keys, vals),
+                                 add))
+        if collect_rows:
+            out = sorted(map(tuple, res.rows()))
+        else:
+            out = sum(len(f) for f in res.frames())
+        res.discard()
+        return out
+
+    result = run_once()  # warm compile caches
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        result = run_once()
+        times.append(time.perf_counter() - t0)
+    if sess.executor.device_group_count() == 0:
+        raise RuntimeError("wave reduce never engaged the device path")
+    return min(times), result
+
+
 def reduce_wave_bench(keys, vals, num_shards: int, iters: int = 3,
                       pipelined: bool = True):
     """Wave-streamed keyed Reduce (S >= 4×N shards on the N-device
@@ -239,7 +276,6 @@ def reduce_wave_bench(keys, vals, num_shards: int, iters: int = 3,
     W times). On a many-core host the prefetch overlap adds on top;
     on a 1-vCPU runner the split + donation carry the win (overlap
     needs a second core to stand on)."""
-    import bigslice_tpu as bs
     from bigslice_tpu.exec.meshexec import MeshExecutor
     from bigslice_tpu.exec.session import Session
 
@@ -250,28 +286,8 @@ def reduce_wave_bench(keys, vals, num_shards: int, iters: int = 3,
         ex = MeshExecutor(mesh, prefetch_depth=0,
                           donate_buffers=False, subid_split=False)
     sess = Session(executor=ex)
-
-    def add(a, b):
-        return a + b
-
-    def run_once():
-        r = bs.Reduce(bs.Const(num_shards, keys, vals), add)
-        res = sess.run(r)
-        total = 0
-        for f in res.frames():
-            total += len(f)
-        res.discard()
-        return total
-
-    run_once()  # warm compile caches
-    times = []
-    for _ in range(iters):
-        t0 = time.perf_counter()
-        distinct = run_once()
-        times.append(time.perf_counter() - t0)
-    if sess.executor.device_group_count() == 0:
-        raise RuntimeError("wave reduce never engaged the device path")
-    best = min(times)
+    best, distinct = _timed_waved_reduce(sess, keys, vals, num_shards,
+                                         iters)
     # Wave-overlap accounting (utils/telemetry.py): how much of the
     # staging time the prefetch pipeline hid behind compute across the
     # whole session — recorded into BENCH json beside rows/sec so the
@@ -293,6 +309,55 @@ def reduce_wave_bench(keys, vals, num_shards: int, iters: int = 3,
          f"{device.get('cache_hits', 0)} hits), "
          f"hbm peak {device.get('hbm_peak_bytes', 0)}")
     return len(keys) / best, overlap, device
+
+
+# ------------------------------------------------------- reduce-wave-2d
+
+def reduce_wave_2d_bench(keys, vals, num_shards: int, shape=None,
+                         iters: int = 3):
+    """Waved keyed Reduce on an explicit mesh topology: ``shape=None``
+    is the flat 1-D mesh, ``shape=(D, I)`` the 2-D DCN × ICI hierarchy
+    whose shuffles route through the two-stage exchange
+    (parallel/hier.py). Returns (rows/sec, sorted result rows, the
+    device-plane exchange totals) — the A/B caller asserts bit-parity
+    between the legs and prints the measured DCN reduction."""
+    import jax
+    from jax.sharding import Mesh
+
+    from bigslice_tpu.exec.meshexec import MeshExecutor
+    from bigslice_tpu.exec.session import Session
+
+    devs = np.array(jax.devices())
+    if shape is None:
+        mesh = Mesh(devs, ("shards",))
+    else:
+        d, i = shape
+        if d * i != devs.size:
+            raise RuntimeError(
+                f"reduce-wave-2d needs a {d}x{i} device grid; got "
+                f"{devs.size} devices (force a CPU mesh with "
+                f"XLA_FLAGS=--xla_force_host_platform_device_count="
+                f"{d * i})"
+            )
+        mesh = Mesh(devs.reshape(d, i), ("dcn", "ici"))
+    sess = Session(executor=MeshExecutor(mesh))
+    best, rows = _timed_waved_reduce(sess, keys, vals, num_shards,
+                                     iters, collect_rows=True)
+    totals = (sess.telemetry_summary().get("device") or {}).get(
+        "totals", {}
+    )
+    exchange = {
+        k: totals.get(k, 0)
+        for k in ("dcn_messages", "dcn_bytes", "ici_messages",
+                  "ici_bytes", "flat_dcn_messages", "flat_dcn_bytes",
+                  "dcn_message_reduction")
+    }
+    label = "1d" if shape is None else f"{shape[0]}x{shape[1]}"
+    note(f"reduce_wave_2d[{label}]: best {best*1e3:.0f} ms, "
+         f"dcn msgs {exchange['dcn_messages']} "
+         f"(flat-equiv {exchange['flat_dcn_messages']}), "
+         f"ici msgs {exchange['ici_messages']}")
+    return len(keys) / best, rows, exchange
 
 
 # ------------------------------------------------------------- staging
@@ -857,7 +922,9 @@ def attention_bench(seq: int, h: int, d: int, iters: int = 5):
     rng = np.random.RandomState(0)
     q, k, v = (rng.randn(seq, h, d).astype(np.float32) * 0.3
                for _ in range(3))
-    sharding = NamedSharding(mesh, P("shards"))
+    from bigslice_tpu.parallel.meshutil import mesh_axis
+
+    sharding = NamedSharding(mesh, P(mesh_axis(mesh)))
     qg, kg, vg = (jax.device_put(x, sharding) for x in (q, k, v))
     flops = 4.0 * seq * seq * h * d
 
@@ -1027,6 +1094,53 @@ def run_mode(mode: str, size, fallback: bool) -> None:
              overlap_efficiency=piped_overlap,
              serial_overlap_efficiency=serial_overlap,
              device=device)
+    elif mode == "reduce-wave-2d":
+        # The multi-pod exchange A/B: the same waved keyed reduce on a
+        # flat 1-D mesh vs the 2-D (dcn, ici) hierarchy over the SAME
+        # devices (2 × N/2 — force an 8-device CPU grid with
+        # --xla_force_host_platform_device_count=8). Results must be
+        # bit-identical; the emitted line carries the measured
+        # dcn-message/bytes columns: the two-stage exchange crosses
+        # DCN with I-fold fewer, I-fold larger messages than the flat
+        # exchange over the same topology.
+        import jax as _jax
+
+        ndev = max(1, len(_jax.devices()))
+        if ndev < 4 or ndev % 2:
+            raise RuntimeError(
+                f"reduce-wave-2d needs an even device count >= 4 "
+                f"(got {ndev}); force a CPU mesh with "
+                f"XLA_FLAGS=--xla_force_host_platform_device_count=8"
+            )
+        shape = (2, ndev // 2)
+        n_rows = size or (1 << 20)
+        S = 2 * ndev
+        rng = np.random.RandomState(42)
+        keys = rng.randint(0, 1 << 20, n_rows).astype(np.int32)
+        vals = np.ones(n_rows, dtype=np.int32)
+        flat_rps, flat_rows, _flat_ex = reduce_wave_2d_bench(
+            keys, vals, S, shape=None
+        )
+        hier_rps, hier_rows, ex = reduce_wave_2d_bench(
+            keys, vals, S, shape=shape
+        )
+        if hier_rows != flat_rows:
+            raise RuntimeError("2-D result differs from the 1-D mesh")
+        note(f"reduce_wave_2d: 1d {flat_rps:,.0f} rows/s, "
+             f"{shape[0]}x{shape[1]} {hier_rps:,.0f} rows/s, dcn "
+             f"messages {ex['dcn_messages']} vs flat-equivalent "
+             f"{ex['flat_dcn_messages']} "
+             f"({ex.get('dcn_message_reduction', 0)}x reduction)")
+        emit("reduce_wave_2d_e2e_rows_per_sec", hier_rps, "rows/sec",
+             flat_rps, mesh_shape=f"{shape[0]}x{shape[1]}",
+             parity="bit-identical",
+             dcn_messages=ex["dcn_messages"],
+             dcn_bytes=ex["dcn_bytes"],
+             ici_messages=ex["ici_messages"],
+             ici_bytes=ex["ici_bytes"],
+             flat_dcn_messages=ex["flat_dcn_messages"],
+             flat_dcn_bytes=ex["flat_dcn_bytes"],
+             dcn_message_reduction=ex.get("dcn_message_reduction"))
     elif mode == "reduce-wave-staged":
         # The serving shape: waved Reduce whose shards stage from
         # encoded stream files (read → decode → assemble → upload is
@@ -1178,7 +1292,8 @@ def main():
     fallback = backend in ("cpu", "cpu-fallback")
     args = sys.argv[1:]
     known = ("reduce", "reduce-sort", "reduce-nohash", "reduce-dense",
-             "reduce-wave", "reduce-wave-staged", "staging",
+             "reduce-wave", "reduce-wave-2d", "reduce-wave-staged",
+             "staging",
              "reduce-kernel", "join", "join-dense",
              "join-kernel", "wordcount", "sortshuffle", "cogroup",
              "kmeans", "attention", "matrix")

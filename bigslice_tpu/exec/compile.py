@@ -57,12 +57,21 @@ _compiler_serial = _itertools.count(1)
 
 
 class Compiler:
-    def __init__(self, inv_index: int, machine_combiners: bool = False):
+    def __init__(self, inv_index: int, machine_combiners: bool = False,
+                 mesh_signature=None):
         self.inv_index = inv_index
         # MachineCombiners: share one combiner buffer per process across
         # all producer tasks of a shuffle (exec/session.go:166-176,
         # worker-side two-level combine exec/bigmachine.go:1084-1210).
         self.machine_combiners = machine_combiners
+        # Repr-stable mesh topology signature of the session's executor
+        # ((axis names, shape) from meshutil.MeshTopology, None for
+        # mesh-less executors): stamped into every task's
+        # partition_config so the device-plane compile digest — and the
+        # AOT program-cache key it is designed to become — distinguishes
+        # a 1-D program from a 2-D (dcn, ici) program with the same op
+        # and partitioning.
+        self.mesh_signature = mesh_signature
         # Monotonic serial (not id(self): ids recycle after GC and could
         # merge op groups from different compilations in group-keyed
         # executors).
@@ -204,6 +213,7 @@ class Compiler:
                 part.num_partition,
                 bool(part.combiner),
                 bool(part.partition_fn),
+                self.mesh_signature,
             )
             # The memo key disambiguates same-op task sets compiled for
             # different partition configs (e.g. Reduce vs Reshuffle

@@ -56,7 +56,11 @@ from bigslice_tpu.parallel.jitutil import (
     donation_supported,
     jit_maybe_donate,
 )
-from bigslice_tpu.parallel.meshutil import get_shard_map, mesh_axis
+from bigslice_tpu.parallel.meshutil import (
+    MeshTopology,
+    get_shard_map,
+    mesh_axis,
+)
 from bigslice_tpu.parallel import shuffle as shuffle_mod
 from bigslice_tpu.utils import faultinject, fileio
 
@@ -518,6 +522,15 @@ class MeshExecutor:
 
         self.mesh = mesh
         self.nmesh = int(mesh.devices.size)
+        # Mesh topology (parallel/meshutil.MeshTopology): 1-D flat or
+        # the 2-D DCN × ICI hierarchy. On a hierarchical mesh every
+        # shuffle-boundary group program routes through the two-stage
+        # exchange (parallel/hier.py) — ici-stage combine, dcn-stage
+        # aggregated messages — while per-device programs and signal
+        # psums run over the axis-name tuple (flattened row-major
+        # device order == the 1-D placement, so non-shuffle programs
+        # are bit-identical to the flat mesh's).
+        self.topo = MeshTopology(mesh)
         # Wave pipelining (the overlapped wave pipeline): while wave w's
         # SPMD program computes, a prefetcher thread stages wave
         # w+1..w+depth's inputs (host-tier store reads + device_put),
@@ -1088,6 +1101,7 @@ class MeshExecutor:
             self._spmd_probation.clear()  # fresh chance on the new mesh
             self.mesh = mesh
             self.nmesh = int(mesh.devices.size)
+            self.topo = MeshTopology(mesh)
             self.multiprocess = shuffle_mod.is_multiprocess_mesh(mesh)
         for t, err in lost:  # outside the lock: transitions notify subs
             t.mark_lost(err)
@@ -1579,6 +1593,11 @@ class MeshExecutor:
         if dev is None or self.multiprocess:
             return prog
         try:
+            # Mesh shape + axis names key the digest: a 1-D and a 2-D
+            # program with the same op + partition config are DIFFERENT
+            # compiled artifacts (axis bindings and exchange structure
+            # differ) and must never collide in the executable cache.
+            key_parts = (self.topo.signature(), key_parts)
             if task is not None:
                 op = task.name.op
                 inv = task.name.inv_index
@@ -1646,6 +1665,67 @@ class MeshExecutor:
                                     task0.name.inv_index,
                                     wave, dur_s, exposed_s,
                                     breakdown=breakdown)
+        except Exception:
+            pass
+
+    def _telemetry_exchange(self, task0: Task, wave: int, inputs,
+                            slack: float) -> None:
+        """One wave's collective-exchange plan, split by interconnect
+        axis kind (devicetelemetry.record_exchange). Derived from the
+        STATIC exchange structure — all_to_all moves full buckets, so
+        bucket count × bucket capacity × row bytes IS the traffic the
+        program puts on each axis; on hierarchical meshes the
+        flat-exchange DCN counterfactual rides along as the
+        denominator of the measured I-fold reduction."""
+        dev = self._device_telemetry()
+        if dev is None or task0.num_partition <= 1:
+            return
+        try:
+            topo = self.topo
+            N = self.nmesh
+            nparts = task0.num_partition
+            waved = nparts > N
+            rowbytes = sum(
+                int(np.dtype(ct.dtype).itemsize)
+                * int(np.prod(ct.shape, dtype=np.int64) or 1)
+                for ct in task0.schema
+            ) or 4
+            cap = max((i[2] for i in inputs), default=1)
+            flat_cap = shuffle_mod.send_capacity(
+                cap, N if waved else min(nparts, N), slack
+            )
+            if topo.is_hier:
+                from bigslice_tpu.parallel import hier as hier_mod
+
+                D, I = topo.ndcn, topo.nici
+                # THE kernel builders' own capacity plan (hier.
+                # exchange_plan — one source, no formula drift): bucket
+                # capacities × row bytes, with each stage's int32
+                # routing column (quotient on ICI, subid on DCN when
+                # waved) counted per the plan.
+                plan = hier_mod.exchange_plan(D, I, nparts, cap, slack)
+                ici_msgs = N * (I - 1)
+                dcn_msgs = N * (D - 1)
+                dev.record_exchange(
+                    task0.name.op, task0.name.inv_index, wave,
+                    dcn_messages=dcn_msgs,
+                    dcn_bytes=dcn_msgs * plan["cap2"]
+                    * (rowbytes + 4 * plan["stage2_extra_cols"]),
+                    ici_messages=ici_msgs,
+                    ici_bytes=ici_msgs * plan["cap1"]
+                    * (rowbytes + 4 * plan["stage1_extra_cols"]),
+                    flat_dcn_messages=N * (D - 1) * I,
+                    flat_dcn_bytes=N * (D - 1) * I * flat_cap
+                    * (rowbytes + (4 if waved else 0)),
+                )
+            else:
+                msgs = N * (N - 1)
+                dev.record_exchange(
+                    task0.name.op, task0.name.inv_index, wave,
+                    ici_messages=msgs,
+                    ici_bytes=msgs * flat_cap
+                    * (rowbytes + (4 if waved else 0)),
+                )
         except Exception:
             pass
 
@@ -2095,6 +2175,8 @@ class MeshExecutor:
             for a in s.args
         ]
         raw = program(np.int32(wave), *counts_list, *cols_flat, *extras)
+        if any(k == "shuffle" for k, _, _ in stages):
+            self._telemetry_exchange(task0, wave, inputs, slack)
         return raw, stages, slack
 
     @staticmethod
@@ -2158,6 +2240,11 @@ class MeshExecutor:
                  out_cols) = program(
                     np.int32(wave), *counts_list, *cols_flat, *extras
                 )
+                if any(k == "shuffle" for k, _, _ in stages):
+                    # Every dispatched attempt (first run and slack
+                    # retries alike) put its buckets on the wire.
+                    self._telemetry_exchange(task0, wave, inputs,
+                                             slack)
             has_shuffle = any(k == "shuffle" for k, _, _ in stages)
             if int(np.asarray(gbover)) > 0:
                 # Checked BEFORE badrange: a strict capacity overflow
@@ -2221,7 +2308,14 @@ class MeshExecutor:
                 break
             # slack == ndest makes overflow impossible (a source can
             # send at most `capacity` rows to one destination lane).
-            full_slack = float(max(2, ndest))
+            # The hierarchical exchange needs the full mesh bound:
+            # stage 2's per-group buckets must absorb a stage-1
+            # receive buffer that worst-case concentrates I devices'
+            # whole capacity on one group (cap2 = cap·s/D ≥ I·cap ⇒
+            # s ≥ D·I).
+            full_slack = float(max(
+                2, self.nmesh if self.topo.is_hier else ndest
+            ))
             if slack >= full_slack:
                 raise RuntimeError(
                     f"mesh shuffle overflow in group {task0.name.op} "
@@ -3113,6 +3207,7 @@ class MeshExecutor:
         from jax.sharding import PartitionSpec as P
 
         axis = mesh_axis(self.mesh)
+        topo = self.topo
         nmesh = self.nmesh
         opbase = _op_base(task.name.op)
         shard_map = get_shard_map()
@@ -3488,8 +3583,17 @@ class MeshExecutor:
                            if pf is not None else None)
                     dense_k = (getattr(fc, "dense_keys", None)
                                if fc is not None else None)
+                    # Hierarchical (2-D DCN × ICI) meshes route EVERY
+                    # shuffle boundary through the two-stage exchange
+                    # (parallel/hier.py): the dense/hash fused
+                    # specializations below are single-all_to_all
+                    # lowerings whose one exchange would cross DCN
+                    # I²-fold, so they stay 1-D-only; the hier fused
+                    # kernel keeps the map-side combine (plus an
+                    # ici-stage re-combine) before anything rides DCN.
+                    hier_on = topo.is_hier
                     if (dense_k is not None and pf is None
-                            and nkeys == 1
+                            and nkeys == 1 and not hier_on
                             and s.num_partition == nmesh):
                         # Dense-coded keys: sort-free table combine +
                         # static-routed all_to_all (parallel/dense.py).
@@ -3507,6 +3611,7 @@ class MeshExecutor:
                         overflow = overflow + ov
                         badrange = badrange + nb
                     elif (fc is not None and fc.nkeys == nkeys
+                          and not hier_on
                           and (shops := self._hash_combine_ops(
                               opbase, fc, s.schema)) is not None):
                         # Generic keys, classified ops: sortless fused
@@ -3529,13 +3634,35 @@ class MeshExecutor:
                     elif fc is not None and fc.nkeys == nkeys:
                         # Combiner-bearing shuffle: the fused kernel's
                         # single (validity, dest, keys) sort replaces
-                        # the combine sort + routing sort pair.
-                        body = shuffle_mod.make_combine_shuffle_fn(
-                            nmesh, fc.nkeys, fc.nvals,
-                            segment.canonical_combine(fc.fn, fc.nvals),
-                            axis, slack=slack,
-                            nparts=s.num_partition, partition_fn=pfn,
-                        )
+                        # the combine sort + routing sort pair. On a
+                        # hierarchical mesh the same fused sort runs
+                        # over the ICI stage, an ici-stage combine
+                        # merges group-local partials, and the DCN
+                        # stage moves one aggregated message per pod
+                        # pair per lane (parallel/hier.py).
+                        if hier_on:
+                            from bigslice_tpu.parallel import (
+                                hier as hier_mod,
+                            )
+
+                            body = hier_mod.make_hier_combine_shuffle_fn(
+                                topo.ndcn, topo.nici,
+                                fc.nkeys, fc.nvals,
+                                segment.canonical_combine(fc.fn,
+                                                          fc.nvals),
+                                topo.dcn_axis, topo.ici_axis,
+                                slack=slack, nparts=s.num_partition,
+                                partition_fn=pfn,
+                            )
+                        else:
+                            body = shuffle_mod.make_combine_shuffle_fn(
+                                nmesh, fc.nkeys, fc.nvals,
+                                segment.canonical_combine(fc.fn,
+                                                          fc.nvals),
+                                axis, slack=slack,
+                                nparts=s.num_partition,
+                                partition_fn=pfn,
+                            )
                         mask, ov, nb, cols = body.masked(mask, *cols)
                     else:
                         if fc is not None:
@@ -3550,11 +3677,24 @@ class MeshExecutor:
                                 tuple(cols[fc.nkeys :]),
                             )
                             cols = list(keys) + list(vals)
-                        body = shuffle_mod.make_shuffle_fn(
-                            nmesh, nkeys, cols[0].shape[0], axis,
-                            slack=slack, nparts=s.num_partition,
-                            partition_fn=pfn,
-                        )
+                        if hier_on:
+                            from bigslice_tpu.parallel import (
+                                hier as hier_mod,
+                            )
+
+                            body = hier_mod.make_hier_shuffle_fn(
+                                topo.ndcn, topo.nici, nkeys,
+                                cols[0].shape[0],
+                                topo.dcn_axis, topo.ici_axis,
+                                partition_fn=pfn, slack=slack,
+                                nparts=s.num_partition,
+                            )
+                        else:
+                            body = shuffle_mod.make_shuffle_fn(
+                                nmesh, nkeys, cols[0].shape[0], axis,
+                                slack=slack, nparts=s.num_partition,
+                                partition_fn=pfn,
+                            )
                         mask, ov, nb, cols = body.masked(mask, *cols)
                     cols = list(cols)
                     overflow = overflow + ov

@@ -266,13 +266,19 @@ class Session:
             # granularity). Single-process only — multi-process needs a
             # coordinated platform provider (the default returns None
             # there, and the session re-raises the gang loss).
-            from bigslice_tpu.parallel.meshutil import mesh_axis
+            # Topology-aware: a 2-D (dcn, ici) executor recovers onto
+            # a reshaped (D', I) grid of the surviving devices —
+            # losing a pod row shrinks the DCN axis, not the session.
+            from bigslice_tpu.parallel.meshutil import MeshTopology
             from bigslice_tpu.utils.distributed import (
                 default_mesh_provider,
             )
 
+            topo = MeshTopology(executor.mesh)
             mesh_provider = default_mesh_provider(
-                axis=mesh_axis(executor.mesh)
+                axis=topo.axis if isinstance(topo.axis, str)
+                else "shards",
+                shape=topo.shape if topo.is_hier else None,
             )
         self.mesh_provider = mesh_provider
         self.eventer = eventer
@@ -409,7 +415,8 @@ class Session:
                 args=", ".join(reprlib.repr(a) for a in args),
             )
         tasks = compile_mod.Compiler(
-            inv_index, machine_combiners=self.machine_combiners
+            inv_index, machine_combiners=self.machine_combiners,
+            mesh_signature=self._mesh_signature(),
         ).compile(slice_)
         if self.debug is not None:
             self.debug.register_roots(tasks)
@@ -499,6 +506,21 @@ class Session:
         finally:
             self._gate.release(exclusive)
         return Result(self, slice_, tasks)
+
+    def _mesh_signature(self):
+        """The executor's repr-stable mesh-topology signature (axis
+        names, shape) for compile.Compiler — computed per run, since
+        elastic resize can swap the mesh between runs. None for
+        mesh-less executors (the local tier)."""
+        mesh = getattr(self.executor, "mesh", None)
+        if mesh is None:
+            return None
+        from bigslice_tpu.parallel.meshutil import MeshTopology
+
+        try:
+            return MeshTopology(mesh).signature()
+        except Exception:
+            return None
 
     def _plan_run(self, tasks):
         """Register this evaluation attempt's deterministic group launch

@@ -51,12 +51,21 @@ def spmd_session(mesh=None, parallelism: Optional[int] = None,
     Note: on multi-process meshes the compile-telemetry AOT seam is
     off by design (per-process executable state must not diverge gang
     dispatch); HBM watermarks and donation effectiveness still record
-    from each process's local devices."""
+    from each process's local devices.
+
+    Mesh shape: ``BIGSLICE_MESH_SHAPE=DxI`` builds the 2-D DCN × ICI
+    hierarchy (``Mesh(devices.reshape(D, I), ("dcn", "ici"))`` —
+    shuffles route through the two-stage hierarchical exchange); unset,
+    real multi-slice/multi-host TPU jobs auto-derive the grid from the
+    device fleet's slice/host structure and everything else stays 1-D
+    (meshutil.shape_device_mesh — the identical mesh every prior
+    session built)."""
     from bigslice_tpu.exec.meshexec import MeshExecutor
     from bigslice_tpu.exec.session import Session
+    from bigslice_tpu.parallel.meshutil import shape_device_mesh
 
     if mesh is None:
-        mesh = global_mesh()
+        mesh = shape_device_mesh()
     if coordinator_debug_port is not None and is_coordinator():
         kwargs.setdefault("debug_port", coordinator_debug_port)
     ex = MeshExecutor(mesh, fallback_procs=parallelism, spmd=True)

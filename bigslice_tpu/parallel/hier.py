@@ -56,17 +56,65 @@ from bigslice_tpu.parallel.shuffle import (
 SORTLESS_MAX_LANES = 32
 
 
+def exchange_plan(ndcn: int, nici: int, nparts: int, capacity: int,
+                  slack: float) -> dict:
+    """THE capacity/structure plan of the two-stage exchange — the ONE
+    source both the kernel builders (make_hier_shuffle_fn /
+    make_hier_combine_shuffle_fn) and the executor's exchange
+    telemetry consume, so the recorded per-axis traffic can never
+    drift from the buckets the program actually moves.
+
+    Returns: ``waved`` (nparts > D·I — quotient/subid columns engage),
+    ``ndest1`` (stage-1 ICI lanes addressed), ``cap1`` (per-lane
+    stage-1 bucket rows), ``ngroups`` (DCN groups addressed), ``cap2``
+    (per-group stage-2 bucket rows), ``stage1_extra_cols`` /
+    ``stage2_extra_cols`` (int32 routing columns riding each stage's
+    payload: the quotient on ICI — reported in the fused kernel's
+    shape, present when nparts > I; the plain kernel also carries it
+    in the tiny nparts ≤ I padded edge, a 4 B/row underestimate
+    there — and the subid on DCN when waved)."""
+    nshards = ndcn * nici
+    waved = nparts > nshards
+    ndest1 = max(1, min(nici, nparts))
+    # Stage 2's logical per-group share is capacity/groups-used (a
+    # device's post-stage-1 VALID rows total ~capacity under a uniform
+    # hash); basing cap2 on stage 1's receive buffer would compound
+    # slack twice and double the DCN payload for the same skew
+    # tolerance.
+    ngroups = ndcn if waved else max(1, min(ndcn, -(-nparts // nici)))
+    return {
+        "waved": waved,
+        "ndest1": ndest1,
+        "cap1": send_capacity(capacity, ndest1, slack),
+        "ngroups": ngroups,
+        "cap2": send_capacity(capacity, ngroups, slack),
+        "stage1_extra_cols": 1 if nparts > nici else 0,
+        "stage2_extra_cols": 1 if waved else 0,
+    }
+
+
 def dcn_stage(mask1, dest_g, payload_cols, ndcn: int, cap2: int,
-              dcn_axis: str, sortless: bool):
+              dcn_axis: str, sortless: bool, waved: bool = False):
     """Stage 2 of the hierarchical exchange — ONE implementation shared
     by the plain two-stage shuffle and the fused combine+shuffle reduce:
-    received rows carry their destination group in ``dest_g``; bucket by
-    it and exchange along the slow DCN axis. Each (source-group,
-    dest-group) pair per lane moves as ONE aggregated message. Returns
+    received rows carry their destination group-index in ``dest_g``;
+    bucket by it and exchange along the slow DCN axis. Each
+    (source-group, dest-group) pair per lane moves as ONE aggregated
+    message. ``waved`` handles wave-partitioned outputs (nparts >
+    D·I): ``dest_g`` is then the combined quotient ``part // nici`` =
+    ``subid * ndcn + group``, whose group selects the DCN lane and
+    whose subid rides out as the leading int32 output column — the
+    same subid contract the flat waved shuffle emits. Returns
     (mask2, local_overflow, out_cols)."""
     import jax.numpy as jnp
 
-    g2 = jnp.where(mask1, dest_g, np.int32(ndcn))
+    if waved:
+        g2 = jnp.where(mask1, dest_g % np.int32(ndcn), np.int32(ndcn))
+        payload_cols = (
+            (dest_g // np.int32(ndcn)).astype(np.int32),
+        ) + tuple(payload_cols)
+    else:
+        g2 = jnp.where(mask1, dest_g, np.int32(ndcn))
     d2, cols2, off2, counts2 = route_to_buckets(
         g2, tuple(payload_cols), ndcn, sortless,
     )
@@ -86,7 +134,8 @@ def make_hier_shuffle_fn(ndcn: int, nici: int, nkeys: int,
                          dcn_axis: str = "dcn", ici_axis: str = "ici",
                          seed: int = 0,
                          partition_fn: Optional[Callable] = None,
-                         slack: float = 2.0):
+                         slack: float = 2.0,
+                         nparts: Optional[int] = None):
     """Build the per-device two-stage shuffle body (wrap in shard_map
     over a ("dcn", "ici") mesh).
 
@@ -96,18 +145,26 @@ def make_hier_shuffle_fn(ndcn: int, nici: int, nkeys: int,
     the front. Capacities: cap1 = slack-padded per-lane share of
     ``capacity``; cap2 = slack-padded per-group share of stage 1's
     receive buffer.
+
+    ``nparts`` (default ``ndcn * nici``) is the executor's partition
+    count, with the same contract as ``make_shuffle_fn``: it may be
+    SMALLER than the mesh (padded groups — trailing shards receive
+    nothing) or LARGER (wave-partitioned outputs: partition p lives on
+    shard ``p % (D·I)`` with subid ``p // (D·I)`` emitted as the extra
+    leading int32 output column). Shard numbering stays row-major
+    (``s = g·I + i``), so per-destination row sets match the flat
+    shuffle's for every nparts.
     """
     import jax.numpy as jnp
     from jax import lax
 
     nshards = ndcn * nici
-    cap1 = send_capacity(capacity, nici, slack)
-    recv1 = nici * cap1
-    # Stage 2's logical per-group share is capacity/ndcn (a device's
-    # post-stage-1 VALID rows total ~capacity under a uniform hash);
-    # basing cap2 on recv1 would compound slack twice and double the
-    # DCN payload for the same skew tolerance.
-    cap2 = send_capacity(capacity, ndcn, slack)
+    if nparts is None:
+        nparts = nshards
+    plan = exchange_plan(ndcn, nici, nparts, capacity, slack)
+    waved = plan["waved"]
+    cap1 = plan["cap1"]
+    cap2 = plan["cap2"]
     # Per-stage routing lowering: the shared backend default (sort on
     # real TPU, sortless on CPU meshes) with the lane-count bound.
     base_sortless = sortless_routing_default()
@@ -117,18 +174,22 @@ def make_hier_shuffle_fn(ndcn: int, nici: int, nkeys: int,
     def body_masked(valid, *cols):
         size = cols[0].shape[0]
         keys = cols[:nkeys]
-        # Global destination shard from the SHARED routing contract;
+        # Global destination partition from the SHARED routing contract;
         # out-of-range partitioner ids park at the drop sentinel.
         part, bad, _ = partition_ids(
-            keys, nshards, seed, valid=valid, partition_fn=partition_fn,
+            keys, nparts, seed, valid=valid, partition_fn=partition_fn,
         )
         n_bad = (
             jnp.int32(0) if bad is None
             else (bad & valid).sum().astype(np.int32)
         )
-        routable = part < nshards
+        routable = part < nparts
+        # Quotient index: plain dest group for nparts <= D·I, the
+        # combined subid·D + group encoding in waved mode (dcn_stage
+        # splits it back apart). Non-routable rows drop at stage 1, so
+        # their quotient value never travels.
         dest_g = jnp.where(routable, part // np.int32(nici),
-                           np.int32(ndcn))
+                           np.int32(0))
         dest_i = jnp.where(routable, part % np.int32(nici),
                            np.int32(nici))
 
@@ -154,7 +215,7 @@ def make_hier_shuffle_fn(ndcn: int, nici: int, nkeys: int,
         # exchange's I².
         mask2, ov2, out_cols = dcn_stage(
             mask1, recv_cols[0], recv_cols[1:], ndcn, cap2, dcn_axis,
-            sortless2,
+            sortless2, waved=waved,
         )
 
         # Global signals: any stage's bucket overflow anywhere, plus
@@ -176,6 +237,97 @@ def make_hier_shuffle_fn(ndcn: int, nici: int, nkeys: int,
 
     body.masked = body_masked
     return body
+
+
+def make_hier_combine_shuffle_fn(ndcn: int, nici: int, nkeys: int,
+                                 nvals: int, cfn,
+                                 dcn_axis: str = "dcn",
+                                 ici_axis: str = "ici", seed: int = 0,
+                                 slack: float = 2.0,
+                                 nparts: Optional[int] = None,
+                                 partition_fn: Optional[Callable] = None):
+    """Fused hierarchical combine+shuffle for the executor's 2-D group
+    programs — the combiner-bearing counterpart of
+    ``make_hier_shuffle_fn`` with the same ``.masked`` contract as the
+    flat ``make_combine_shuffle_fn``:
+
+    1. **Stage 1** reuses THE flat fused kernel
+       (shuffle.make_combine_shuffle_fn) in waved mode over the ICI
+       axis: one (validity, lane, quotient, keys) sort segments the
+       map-side combine AND orders the ICI routing, and its leading
+       quotient output column (``part // I``) is exactly what stage 2
+       buckets on.
+    2. **ICI-stage combine**: the ≤I group-local partials per
+       (destination shard, key) that stage 1 collected on one device
+       merge into ONE partial *before anything crosses DCN* — the
+       quotient rides as an extra leading key so rows of different
+       destination shards never merge. On top of the I-fold message
+       amortization this shrinks the DCN payload itself: one partial
+       per (source group, key) instead of one per (source device,
+       key).
+    3. **DCN stage**: the shared ``dcn_stage`` exchange (one
+       aggregated message per pod pair per lane; waved subids ride
+       out as the leading column).
+
+    Received rows are per-source-group partials; consumers re-combine
+    by the map-side-combine contract exactly as they do for the flat
+    fused kernel's per-source-device partials.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    from bigslice_tpu.parallel import segment
+
+    nshards = ndcn * nici
+    if nparts is None:
+        nparts = nshards
+    # Stage 1 (the flat fused kernel over ICI) emits the quotient
+    # column only when it routes more partitions than ICI lanes.
+    stage1_waved = nparts > nici
+    sortless2 = (sortless_routing_default()
+                 and ndcn <= SORTLESS_MAX_LANES)
+    fused1 = make_combine_shuffle_fn(
+        nici, nkeys, nvals, cfn, ici_axis, seed,
+        partition_fn=partition_fn, slack=slack, nparts=nparts,
+    )
+    recombine = segment.make_segmented_reduce_masked(
+        1 + nkeys, nvals, cfn, compact=False
+    )
+
+    def body_masked(valid, *cols):
+        size = cols[0].shape[0]
+        plan = exchange_plan(ndcn, nici, nparts, size, slack)
+        waved_out = plan["waved"]
+        cap2 = plan["cap2"]
+        mask1, ov1, bad1, s1 = fused1.masked(valid, *cols)
+        if stage1_waved:
+            gq = s1[0]
+            keys1 = tuple(s1[1:1 + nkeys])
+            vals1 = tuple(s1[1 + nkeys:])
+        else:
+            # nparts <= I: every partition lives in group 0 and the
+            # flat kernel emitted no quotient column.
+            gq = jnp.zeros(s1[0].shape[0], np.int32)
+            keys1 = tuple(s1[:nkeys])
+            vals1 = tuple(s1[nkeys:])
+        mask_c, kc, vc = recombine(mask1, (gq,) + keys1, vals1)
+        mask2, ov2, out_cols = dcn_stage(
+            mask_c, kc[0], tuple(kc[1:]) + tuple(vc), ndcn, cap2,
+            dcn_axis, sortless2, waved=waved_out,
+        )
+        # fused1's signals are already psummed over ICI; lift both to
+        # global totals.
+        overflow = (
+            lax.psum(ov1, dcn_axis)
+            + lax.psum(lax.psum(ov2, ici_axis), dcn_axis)
+        )
+        bad = lax.psum(bad1, dcn_axis)
+        return mask2, overflow, bad, out_cols
+
+    class _Body:
+        masked = staticmethod(body_masked)
+
+    return _Body()
 
 
 class HierMeshReduceByKey:

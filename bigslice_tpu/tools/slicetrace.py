@@ -38,6 +38,10 @@ plus the telemetry-hub sections (utils/telemetry.py):
 - ``invN:device`` — per-wave HBM watermarks (allocator stats, or the
   live-array fallback on CPU meshes) and per-op donation
   effectiveness (``bigslice:hbm`` / ``bigslice:donation`` instants).
+- ``invN:exchange`` — per-op collective-exchange messages/bytes split
+  by interconnect axis kind (dcn vs ici, plus the flat-exchange DCN
+  counterfactual; ``bigslice:exchange`` instants — the 2-D DCN × ICI
+  hierarchy's measured traffic-reduction column).
 
 Traces from older sessions (no ``inv`` task args) fall back to one
 flat all-ops quartile table.
@@ -125,6 +129,7 @@ def _print_inv(out: List[str], inv, summary: dict, tasks: List[dict],
     _print_compile(out, inv, telem.get("compile", ()))
     _print_device(out, inv, telem.get("hbm", ()),
                   telem.get("donation", ()))
+    _print_exchange(out, inv, telem.get("exchange", ()))
     out.append("")
 
 
@@ -325,6 +330,40 @@ def _print_device(out: List[str], inv, hbm, donation):
                        f"{ali / 1e6:>11.2f} {eff:>5.1%}")
 
 
+def _print_exchange(out: List[str], inv, events):
+    """Per-op collective-exchange attribution split by interconnect
+    axis kind, from bigslice:exchange instants (the 2-D DCN × ICI
+    hierarchy's measured DCN-traffic column; flat_dcn is the
+    1-stage-exchange counterfactual over the same topology)."""
+    agg: Dict[str, dict] = {}
+    for ev in events:
+        a = ev.get("args", {})
+        d = agg.setdefault(a.get("op", "?"), {
+            "waves": 0, "dcn_m": 0, "dcn_b": 0, "ici_m": 0,
+            "ici_b": 0, "flat_m": 0,
+        })
+        d["waves"] += 1
+        d["dcn_m"] += a.get("dcn_messages", 0) or 0
+        d["dcn_b"] += a.get("dcn_bytes", 0) or 0
+        d["ici_m"] += a.get("ici_messages", 0) or 0
+        d["ici_b"] += a.get("ici_bytes", 0) or 0
+        d["flat_m"] += a.get("flat_dcn_messages", 0) or 0
+    if not agg:
+        return
+    out.append(f"# inv{inv}:exchange (collective messages by axis kind)")
+    out.append(f"  {'op':<28} {'waves':>5} {'dcn_msg':>8} "
+               f"{'dcn_MB':>8} {'ici_msg':>8} {'ici_MB':>8} "
+               f"{'vs_flat':>8}")
+    for op, d in sorted(agg.items()):
+        red = (f"{d['flat_m'] / d['dcn_m']:.1f}x"
+               if d["dcn_m"] and d["flat_m"] else "-")
+        out.append(
+            f"  {op[:28]:<28} {d['waves']:>5} {d['dcn_m']:>8} "
+            f"{d['dcn_b'] / 1e6:>8.2f} {d['ici_m']:>8} "
+            f"{d['ici_b'] / 1e6:>8.2f} {red:>8}"
+        )
+
+
 def analyze(path: str) -> str:
     with open(path) as fp:
         doc = json.load(fp)
@@ -339,6 +378,7 @@ def analyze(path: str) -> str:
         "bigslice:compile": "compile",
         "bigslice:hbm": "hbm",
         "bigslice:donation": "donation",
+        "bigslice:exchange": "exchange",
     }
     n_tasks = n_instants = 0
     for ev in doc.get("traceEvents", []):

@@ -242,6 +242,18 @@ class _OpDeviceRecord:
         self.donation_aliased_bytes = 0
         self.donation_buffers = 0
         self.donation_aliased_buffers = 0
+        # collective-exchange attribution, split by axis kind (the 2-D
+        # DCN × ICI hierarchy's measured column): messages/bytes the
+        # op's shuffle programs put on each interconnect class, plus
+        # the flat-exchange equivalent a 1-stage all_to_all over the
+        # same topology would have sent across DCN.
+        self.exchange_waves = 0
+        self.dcn_messages = 0
+        self.dcn_bytes = 0
+        self.ici_messages = 0
+        self.ici_bytes = 0
+        self.flat_dcn_messages = 0
+        self.flat_dcn_bytes = 0
 
 
 class DeviceTelemetry:
@@ -424,6 +436,40 @@ class DeviceTelemetry:
                    expected_bytes=int(expected_bytes),
                    aliased_bytes=int(aliased_bytes))
 
+    # -- exchange attribution (DCN × ICI axis split) ----------------------
+
+    def record_exchange(self, op: str, inv: Optional[int],
+                        wave: Optional[int],
+                        dcn_messages: int = 0, dcn_bytes: int = 0,
+                        ici_messages: int = 0, ici_bytes: int = 0,
+                        flat_dcn_messages: int = 0,
+                        flat_dcn_bytes: int = 0) -> None:
+        """One wave's collective-exchange plan, split by interconnect
+        axis kind: messages/bytes the shuffle's all_to_all buckets put
+        on the slow DCN axis vs the fast ICI axis (derived from the
+        static exchange structure — bucket capacities × row bytes are
+        the bytes the collective actually moves, valid or padding).
+        ``flat_dcn_*`` is the counterfactual a single flat all_to_all
+        over the same (D, I) topology would have crossed DCN with —
+        the denominator of the I-fold reduction column. 1-D meshes
+        record everything as ICI with dcn = 0."""
+        with self._lock:
+            rec = self._op(op, inv)
+            rec.exchange_waves += 1
+            rec.dcn_messages += max(0, int(dcn_messages))
+            rec.dcn_bytes += max(0, int(dcn_bytes))
+            rec.ici_messages += max(0, int(ici_messages))
+            rec.ici_bytes += max(0, int(ici_bytes))
+            rec.flat_dcn_messages += max(0, int(flat_dcn_messages))
+            rec.flat_dcn_bytes += max(0, int(flat_dcn_bytes))
+        self._emit("bigslice:exchange", op=op, inv=inv, wave=wave,
+                   dcn_messages=int(dcn_messages),
+                   dcn_bytes=int(dcn_bytes),
+                   ici_messages=int(ici_messages),
+                   ici_bytes=int(ici_bytes),
+                   flat_dcn_messages=int(flat_dcn_messages),
+                   flat_dcn_bytes=int(flat_dcn_bytes))
+
     # -- queries ----------------------------------------------------------
 
     def status_line(self) -> Optional[str]:
@@ -449,6 +495,10 @@ class DeviceTelemetry:
             tot_wall = tot_flops = tot_bytes = 0.0
             donation = {}
             don_expected = don_aliased = 0
+            exchange = {}
+            ex_tot = {"dcn_messages": 0, "dcn_bytes": 0,
+                      "ici_messages": 0, "ici_bytes": 0,
+                      "flat_dcn_messages": 0, "flat_dcn_bytes": 0}
             for op, rec in self._ops.items():
                 if rec.compiles or rec.cache_hits:
                     compile_ops[op] = {
@@ -478,6 +528,25 @@ class DeviceTelemetry:
                     }
                     don_expected += rec.donation_expected_bytes
                     don_aliased += rec.donation_aliased_bytes
+                if rec.exchange_waves:
+                    entry = {
+                        "waves": rec.exchange_waves,
+                        "dcn_messages": rec.dcn_messages,
+                        "dcn_bytes": rec.dcn_bytes,
+                        "ici_messages": rec.ici_messages,
+                        "ici_bytes": rec.ici_bytes,
+                    }
+                    if rec.flat_dcn_messages:
+                        entry["flat_dcn_messages"] = rec.flat_dcn_messages
+                        entry["flat_dcn_bytes"] = rec.flat_dcn_bytes
+                        if rec.dcn_messages:
+                            entry["dcn_message_reduction"] = round(
+                                rec.flat_dcn_messages
+                                / rec.dcn_messages, 4
+                            )
+                    exchange[op] = entry
+                    for k in ex_tot:
+                        ex_tot[k] += getattr(rec, k)
             hbm: dict = {}
             if self._hbm:
                 hbm = {
@@ -492,21 +561,30 @@ class DeviceTelemetry:
                     hbm["peak_frac"] = round(
                         self._hbm_peak_bytes / self._hbm_limit_bytes, 4
                     )
+        totals = {
+            "compiles": tot_compiles,
+            "cache_hits": tot_hits,
+            "compile_s": round(tot_wall, 6),
+            "flops": tot_flops,
+            "bytes_accessed": tot_bytes,
+            "hbm_peak_bytes": self._hbm_peak_bytes,
+            "donation_effectiveness": round(
+                don_aliased / don_expected, 4
+            ) if don_expected else None,
+        }
+        if exchange:
+            totals.update(ex_tot)
+            if ex_tot["dcn_messages"] and ex_tot["flat_dcn_messages"]:
+                totals["dcn_message_reduction"] = round(
+                    ex_tot["flat_dcn_messages"]
+                    / ex_tot["dcn_messages"], 4
+                )
         out = {
             "compile": compile_ops,
             "hbm": hbm,
             "donation": donation,
-            "totals": {
-                "compiles": tot_compiles,
-                "cache_hits": tot_hits,
-                "compile_s": round(tot_wall, 6),
-                "flops": tot_flops,
-                "bytes_accessed": tot_bytes,
-                "hbm_peak_bytes": self._hbm_peak_bytes,
-                "donation_effectiveness": round(
-                    don_aliased / don_expected, 4
-                ) if don_expected else None,
-            },
+            "exchange": exchange,
+            "totals": totals,
         }
         return out
 
@@ -559,6 +637,27 @@ class DeviceTelemetry:
                 line("bigslice_donation_bytes_total",
                      {"op": op, "kind": "aliased"},
                      rec.donation_aliased_bytes)
+        metric("bigslice_exchange_messages_total",
+               "Collective-exchange messages per op, split by "
+               "interconnect axis kind (dcn/ici; dcn_flat = the "
+               "flat-exchange counterfactual).", "counter")
+        metric("bigslice_exchange_bytes_total",
+               "Collective-exchange bucket bytes per op, split by "
+               "interconnect axis kind.", "counter")
+        for op, rec in ops.items():
+            if not rec.exchange_waves:
+                continue
+            for axis, msgs, nbytes in (
+                ("dcn", rec.dcn_messages, rec.dcn_bytes),
+                ("ici", rec.ici_messages, rec.ici_bytes),
+                ("dcn_flat", rec.flat_dcn_messages,
+                 rec.flat_dcn_bytes),
+            ):
+                if msgs:
+                    line("bigslice_exchange_messages_total",
+                         {"op": op, "axis": axis}, msgs)
+                    line("bigslice_exchange_bytes_total",
+                         {"op": op, "axis": axis}, nbytes)
         if hbm_last is not None:
             metric("bigslice_hbm_bytes",
                    "Device-memory watermark (max across devices; "

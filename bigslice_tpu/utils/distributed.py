@@ -250,15 +250,24 @@ def global_mesh(axis: str = "shards"):
 
 
 def default_mesh_provider(axis: str = "shards",
-                          probe_timeout: float = 5.0):
+                          probe_timeout: float = 5.0,
+                          shape=None):
     """Built-in healthy-device discovery for elastic Sessions — the
     demand-driven capacity loop the reference runs per machine
     (exec/slicemachine.go:586-601), at device granularity: each call
     probes every visible device with a tiny put+compute (bounded by
     ``probe_timeout`` in a worker thread — a wedged device must not
-    hang recovery) and returns a 1-D mesh of the responders, or None
-    when nothing answers (the session then re-raises the original
-    gang loss).
+    hang recovery) and returns a mesh of the responders, or None when
+    nothing answers (the session then re-raises the original gang
+    loss).
+
+    ``shape=(D, I)`` preserves a 2-D (dcn, ici) session's topology:
+    the responders regroup as ``(len(healthy) // I, I)`` — a lost pod
+    row shrinks the DCN axis — falling back to a flat 1-D mesh of
+    EVERY healthy device when fewer than two full ICI groups survive
+    (a 1×I grid is degenerate and would discard responders; programs
+    all reset on resize either way, so the degraded-to-flat mesh still
+    computes correct results).
 
     Single-process scope: in SPMD multi-process mode device health can
     differ per process, and an asymmetric mesh choice would wedge the
@@ -303,6 +312,42 @@ def default_mesh_provider(axis: str = "shards",
         healthy = [d for i, d in enumerate(devs) if ok[i]]
         if not healthy:
             return None
+        if shape is not None:
+            from bigslice_tpu.parallel.meshutil import (
+                HIER_AXIS_NAMES,
+                structure_groups,
+            )
+
+            _d, i = shape
+            # Pod-contiguous regrouping on real hardware: group the
+            # survivors by slice/host (meshutil.structure_groups,
+            # ragged groups allowed — a pod that lost a chip is
+            # exactly the degraded case this provider exists for) and
+            # keep the first ``i`` chips of each group still holding
+            # ≥ i, so every rebuilt "ici" row stays one physical pod —
+            # a raw reshape of an interleaved survivor list would put
+            # chips of different pods on one ICI row and every ICI
+            # collective would cross DCN. Fleets without multi-group
+            # structure (virtual CPU grids) keep the contiguous-order
+            # regroup: there is no physical pod to misalign.
+            groups = structure_groups(healthy, uniform=False)
+            if groups is not None:
+                grid_devs = [d for g in groups
+                             if len(g) >= i for d in g[:i]]
+            else:
+                grid_devs = healthy[: (len(healthy) // i) * i]
+            d2 = len(grid_devs) // i
+            # Rebuild the hierarchy only while it still IS one (two or
+            # more full ICI groups): a (1, I) grid is degenerate (flat
+            # routing anyway) and truncating to it would discard
+            # healthy responders — the flat mesh of EVERYTHING healthy
+            # strictly dominates there. Programs reset on resize
+            # either way.
+            if d2 >= 2:
+                return Mesh(
+                    np.array(grid_devs).reshape(d2, i),
+                    HIER_AXIS_NAMES,
+                )
         return Mesh(np.array(healthy), (axis,))
 
     return provide

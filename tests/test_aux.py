@@ -234,6 +234,15 @@ def test_run_cli_pod_launch(tmp_path):
          "-launch", "2", str(prog)],
         env=env, capture_output=True, text=True, timeout=240,
     )
+    if (out.returncode != 0
+            and "Multiprocess computations aren't implemented"
+            in out.stderr):
+        # Capability skip, not a product failure: this jaxlib's CPU
+        # backend refuses cross-process collectives outright, so the
+        # two-process loopback simulation cannot run here. Real
+        # multi-host coverage lives in tools/multihost_smoke.py on
+        # backends that implement it.
+        pytest.skip("jax CPU backend lacks multiprocess collectives")
     assert out.returncode == 0, (out.stdout, out.stderr)
     assert "POD_OK 600" in out.stdout
 
@@ -549,6 +558,10 @@ def test_backend_probe_retries(monkeypatch):
 def test_cache_files_are_zstd_compressed(tmp_path):
     """Writethrough compresses (the reference's slicecache zstd,
     internal/slicecache/sliceio.go:53-96); reads sniff the container."""
+    # The writer degrades to plain frames when zstd is absent (by
+    # design — codec.maybe_zstd_writer returns None); only the
+    # compressed-container assertion needs the module.
+    pytest.importorskip("zstandard")
     import numpy as np
 
     import bigslice_tpu as bs

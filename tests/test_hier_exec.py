@@ -1,0 +1,292 @@
+"""Executor-driven 2-D (DCN × ICI) runs: MeshExecutor over
+``Mesh(devices.reshape(2, 4), ("dcn", "ici"))`` must produce the same
+results as the 1-D ×8 mesh over the same devices — with the shuffle
+boundaries routed through the hierarchical two-stage exchange
+(parallel/hier.py) and the device telemetry proving the I-fold DCN
+message reduction vs the flat exchange."""
+
+import numpy as np
+import pytest
+
+import jax
+
+import bigslice_tpu as bs
+from bigslice_tpu.exec.meshexec import MeshExecutor
+from bigslice_tpu.exec.session import Session
+from bigslice_tpu.parallel import meshutil
+from bigslice_tpu.utils import faultinject
+
+NDCN, NICI = 2, 4
+
+
+def _flat_mesh():
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(jax.devices()[:8]), ("shards",))
+
+
+def _grid_mesh():
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(jax.devices()[:8]).reshape(NDCN, NICI),
+                ("dcn", "ici"))
+
+
+def _session(mesh, **ex_kwargs):
+    return Session(executor=MeshExecutor(mesh, **ex_kwargs))
+
+
+def _keyed(rows=6000, nkeys=251, seed=5):
+    rng = np.random.RandomState(seed)
+    return (rng.randint(0, nkeys, rows).astype(np.int32),
+            rng.randint(0, 50, rows).astype(np.int32))
+
+
+def _reduce_oracle(keys, vals):
+    out = {}
+    for k, v in zip(keys.tolist(), vals.tolist()):
+        out[k] = out.get(k, 0) + v
+    return out
+
+
+# -- topology knob / probe ------------------------------------------------
+
+
+def test_mesh_shape_env_knob(monkeypatch):
+    monkeypatch.delenv("BIGSLICE_MESH_SHAPE", raising=False)
+    mesh = meshutil.shape_device_mesh(jax.devices()[:8])
+    assert mesh.axis_names == ("shards",)
+    assert mesh.devices.shape == (8,)
+    assert not meshutil.MeshTopology(mesh).is_hier
+
+    monkeypatch.setenv("BIGSLICE_MESH_SHAPE", "2x4")
+    mesh2 = meshutil.shape_device_mesh(jax.devices()[:8])
+    assert mesh2.axis_names == ("dcn", "ici")
+    assert mesh2.devices.shape == (2, 4)
+    topo = meshutil.MeshTopology(mesh2)
+    assert topo.is_hier and (topo.ndcn, topo.nici) == (2, 4)
+    # Row-major device order preserved: shard s is devices[s] either way.
+    assert list(mesh2.devices.flat) == list(mesh.devices.flat)
+
+    monkeypatch.setenv("BIGSLICE_MESH_SHAPE", "3x3")
+    with pytest.raises(ValueError):
+        meshutil.shape_device_mesh(jax.devices()[:8])
+    monkeypatch.setenv("BIGSLICE_MESH_SHAPE", "bogus")
+    with pytest.raises(ValueError):
+        meshutil.mesh_shape_from_env()
+
+
+def test_mesh_axis_designators():
+    assert meshutil.mesh_axis(_flat_mesh()) == "shards"
+    assert meshutil.mesh_axis(_grid_mesh()) == ("dcn", "ici")
+    # Degenerate 2-D grids keep flat routing (no second tier).
+    from jax.sharding import Mesh
+
+    degen = Mesh(np.array(jax.devices()[:8]).reshape(1, 8),
+                 ("dcn", "ici"))
+    assert not meshutil.MeshTopology(degen).is_hier
+
+
+# -- keyed reduce: bit-parity + measured DCN reduction --------------------
+
+
+def test_reduce_2d_bit_parity_and_dcn_reduction():
+    keys, vals = _keyed()
+
+    def run(mesh):
+        sess = _session(mesh)
+        res = sess.run(bs.Reduce(bs.Const(8, keys, vals),
+                                 lambda a, b: a + b))
+        rows = list(map(tuple, res.rows()))
+        assert sess.executor.device_group_count() > 0
+        return rows, sess
+
+    rows_1d, _ = run(_flat_mesh())
+    rows_2d, sess2 = run(_grid_mesh())
+    # Bit-identical, raw order included: the hierarchical exchange
+    # lands the same per-partition row sets and the reduce-side combine
+    # orders them identically.
+    assert rows_2d == rows_1d
+    assert dict(rows_2d) == _reduce_oracle(keys, vals)
+
+    totals = sess2.telemetry_summary()["device"]["totals"]
+    assert totals["dcn_messages"] > 0
+    # The measured column: the flat exchange over the same (D, I)
+    # topology crosses DCN with I× the messages the two-stage exchange
+    # sends.
+    assert totals["flat_dcn_messages"] == NICI * totals["dcn_messages"]
+    assert totals["dcn_message_reduction"] == pytest.approx(NICI)
+    # I-fold FEWER, I-fold LARGER: total DCN bytes stay bounded by the
+    # flat exchange's while each message carries I× the payload — the
+    # DCN-latency amortization shape.
+    assert totals["dcn_bytes"] <= totals["flat_dcn_bytes"]
+    per_msg = totals["dcn_bytes"] / totals["dcn_messages"]
+    flat_per_msg = (totals["flat_dcn_bytes"]
+                    / totals["flat_dcn_messages"])
+    assert per_msg == pytest.approx(NICI * flat_per_msg)
+    # Both planes surface it: Prometheus carries the axis split...
+    text = sess2.telemetry.prometheus_text()
+    assert 'bigslice_exchange_messages_total' in text
+    assert 'axis="dcn"' in text and 'axis="ici"' in text
+    # ...and the per-op exchange section names the op.
+    exchange = sess2.telemetry_summary()["device"]["exchange"]
+    assert any(e["dcn_messages"] for e in exchange.values())
+
+
+def test_reduce_1d_records_no_dcn_traffic():
+    keys, vals = _keyed(rows=1200, nkeys=31)
+    sess = _session(_flat_mesh())
+    res = sess.run(bs.Reduce(bs.Const(8, keys, vals),
+                             lambda a, b: a + b))
+    assert dict(map(tuple, res.rows())) == _reduce_oracle(keys, vals)
+    totals = sess.telemetry_summary()["device"]["totals"]
+    assert totals["dcn_messages"] == 0
+    assert totals["ici_messages"] > 0
+
+
+@pytest.mark.parametrize("arena", [True, False], ids=["arena", "noarena"])
+@pytest.mark.parametrize("prefetch", [0, 2], ids=["pf0", "pf2"])
+def test_reduce_2d_waved_parity(prefetch, arena):
+    """S = 2×N shards: the waved subid path (wave planning, subid
+    pre-split, staging arena, donation) over the hierarchical exchange,
+    across the arena × prefetch matrix — bit-parity 2×4 vs 1-D×8."""
+    keys, vals = _keyed(rows=4000, nkeys=97, seed=9)
+
+    def run(mesh):
+        sess = _session(mesh, prefetch_depth=prefetch,
+                        staging_arena=arena)
+        res = sess.run(bs.Reduce(bs.Const(16, keys, vals),
+                                 lambda a, b: a + b))
+        rows = list(map(tuple, res.rows()))
+        assert sess.executor.device_group_count() > 0
+        return rows
+
+    assert run(_grid_mesh()) == run(_flat_mesh())
+
+
+# -- plain shuffle + join --------------------------------------------------
+
+
+def test_shuffle_2d_parity():
+    """Reshuffle (combinerless shuffle): same per-shard row SETS as the
+    flat mesh (within-shard order is not part of the shuffle contract —
+    the two-stage exchange interleaves sources differently)."""
+    rng = np.random.RandomState(3)
+    keys = rng.randint(0, 1000, 3000).astype(np.int32)
+    vals = np.arange(3000, dtype=np.int32)
+
+    def run(mesh):
+        sess = _session(mesh)
+        res = sess.run(bs.Reshuffle(bs.Const(8, keys, vals)))
+        shard_rows = [
+            sorted(map(tuple, (r for f in res.reader(s, ())
+                               for r in f.rows())))
+            for s in range(res.num_shards)
+        ]
+        assert sess.executor.device_group_count() > 0
+        return shard_rows
+
+    assert run(_grid_mesh()) == run(_flat_mesh())
+
+
+def test_join_2d_parity():
+    rng = np.random.RandomState(7)
+    ak = rng.randint(0, 97, 2000).astype(np.int32)
+    av = np.ones(2000, np.int32)
+    bk = rng.randint(0, 97, 1500).astype(np.int32)
+    bv = np.full(1500, 2, np.int32)
+
+    def run(mesh):
+        sess = _session(mesh)
+        res = sess.run(bs.JoinAggregate(
+            bs.Const(8, ak, av), bs.Const(8, bk, bv),
+            lambda a, b: a + b, lambda a, b: a + b,
+        ))
+        assert sess.executor.device_group_count() > 0
+        return sorted(map(tuple, res.rows()))
+
+    assert run(_grid_mesh()) == run(_flat_mesh())
+
+
+def test_groupby_2d_parity():
+    rng = np.random.RandomState(11)
+    keys = rng.randint(0, 40, 1200).astype(np.int32)
+    vals = rng.randint(0, 9, 1200).astype(np.int32)
+
+    def run(mesh):
+        sess = _session(mesh)
+        res = sess.run(bs.GroupByKey(bs.Const(8, keys, vals),
+                                     capacity=64))
+        rows = sorted(
+            (int(k), sorted(np.asarray(g)[:int(n)].tolist()))
+            for k, g, n in map(tuple, res.rows())
+        )
+        assert sess.executor.device_group_count() > 0
+        return rows
+
+    assert run(_grid_mesh()) == run(_flat_mesh())
+
+
+def test_cogroup_2d_parity():
+    rng = np.random.RandomState(19)
+    ka = rng.randint(0, 40, 1200).astype(np.int32)
+    va = rng.randint(0, 9, 1200).astype(np.int32)
+    kb = rng.randint(0, 40, 900).astype(np.int32)
+    vb = rng.randint(0, 9, 900).astype(np.int32)
+
+    def run(mesh):
+        sess = _session(mesh)
+        res = sess.run(bs.Cogroup(bs.Const(8, ka, va),
+                                  bs.Const(8, kb, vb)))
+        rows = sorted((r[0], sorted(r[1]), sorted(r[2]))
+                      for r in map(tuple, res.rows()))
+        assert sess.executor.device_group_count() > 0
+        return rows
+
+    assert run(_grid_mesh()) == run(_flat_mesh())
+
+
+# -- chaos: host loss on the DCN axis → elastic recovery ------------------
+
+
+def test_2d_hostloss_recovers_through_elastic(monkeypatch):
+    """An injected gang-member loss on the 2-D mesh rides the same
+    elastic ladder as the flat mesh: the session backs off, re-forms a
+    (D', I) grid through the topology-aware default mesh provider, and
+    completes bit-identical — and the recovered executor is still
+    hierarchical."""
+    monkeypatch.setenv("BIGSLICE_ELASTIC_BACKOFF", "0.01")
+    keys, vals = _keyed(rows=1500, nkeys=53, seed=13)
+    events = []
+    plan = faultinject.install(
+        faultinject.parse_plan("9:mesh.dispatch=1.0x1~hostloss")
+    )
+    try:
+        sess = Session(executor=MeshExecutor(_grid_mesh()), elastic=1,
+                       eventer=lambda name, **f: events.append(name))
+        res = sess.run(bs.Reduce(bs.Const(8, keys, vals),
+                                 lambda a, b: a + b))
+        assert dict(map(tuple, res.rows())) == _reduce_oracle(keys,
+                                                              vals)
+    finally:
+        faultinject.clear()
+    assert plan.snapshot()["injected"] == {"mesh.dispatch": 1}
+    assert "bigslice:elasticRetry" in events
+    topo = sess.executor.topo
+    assert topo.is_hier and topo.nici == NICI
+
+
+def test_2d_resize_to_flat_still_computes():
+    """Degraded recovery: resizing a 2-D executor onto a 1-D mesh (not
+    enough survivors for a full ICI group) resets programs and keeps
+    computing correct results on the flat path."""
+    keys, vals = _keyed(rows=1000, nkeys=23, seed=17)
+    sess = _session(_grid_mesh())
+    res = sess.run(bs.Reduce(bs.Const(8, keys, vals),
+                             lambda a, b: a + b))
+    assert dict(map(tuple, res.rows())) == _reduce_oracle(keys, vals)
+    sess.executor.resize(_flat_mesh())
+    assert not sess.executor.topo.is_hier
+    res2 = sess.run(bs.Reduce(bs.Const(8, keys, vals),
+                              lambda a, b: a + b))
+    assert dict(map(tuple, res2.rows())) == _reduce_oracle(keys, vals)
