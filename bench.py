@@ -22,6 +22,11 @@ Modes (argv[1], default "reduce"):
                   ScanReader → host parse → dict-encode → device Reduce,
                   all through the Session (models/urls).
 - ``sortshuffle`` config #4: Reshuffle + per-shard device sort.
+- ``serve-qps``   sustained serving load against a live ServeServer
+                  (serve/server.py): QPS + p50/p99 latency, warm-vs-
+                  cold first-request latency across a FRESH Session
+                  (zero XLA compiles via the cross-Session program
+                  cache — enforced), program-cache hit rate.
 - ``cogroup``     the general ragged Cogroup: device tagged-sort +
                   rank-scatter lowering (discovered capacity) vs the
                   exact host sorted-merge tier as baseline.
@@ -44,6 +49,16 @@ import sys
 import time
 
 import numpy as np
+
+
+def _add(a, b):
+    """THE combine fn every bench shares. Module-level on purpose:
+    program/jit caches key on fn identity (and the cross-Session
+    program cache on fn *content*), so a fresh lambda per bench — or
+    per timing iteration — would recompile every kernel and pollute
+    the warm-path numbers the serve-qps bench depends on. Each bench
+    still runs an explicit warm pass before its timed region."""
+    return a + b
 
 
 def emit(metric: str, value: float, unit: str, baseline: float,
@@ -124,7 +139,7 @@ def reduce_kernel_bench(keys, vals, iters: int = 5):
     )
     red = shuffle_mod.MeshReduceByKey(
         mesh, nkeys=1, nvals=1, capacity=cap,
-        combine_fn=lambda a, b: a + b,
+        combine_fn=_add,
     )
 
     def run_once():
@@ -168,14 +183,11 @@ def reduce_e2e_bench(keys, vals, iters: int = 3, dense_keys=None,
     ))
     n = mesh.devices.size
 
-    def add(a, b):
-        return a + b
-
     def run_once():
         # Stable fn identity across iterations: program/jit caches key
         # on id(fn), so rebuilding the slice each round reuses the
         # compiled SPMD program (the iterative-driver steady state).
-        r = bs.Reduce(bs.Const(n, keys, vals), add,
+        r = bs.Reduce(bs.Const(n, keys, vals), _add,
                       dense_keys=dense_keys)
         res = sess.run(r)
         total = 0
@@ -237,12 +249,9 @@ def _timed_waved_reduce(sess, keys, vals, num_shards: int, iters: int,
     2-D A/B's parity evidence)."""
     import bigslice_tpu as bs
 
-    def add(a, b):
-        return a + b
-
     def run_once():
         res = sess.run(bs.Reduce(bs.Const(num_shards, keys, vals),
-                                 add))
+                                 _add))
         if collect_rows:
             out = sorted(map(tuple, res.rows()))
         else:
@@ -501,9 +510,6 @@ def reduce_wave_staged_bench(n_rows: int, dim: int = 16,
 
         return read_shard
 
-    def add(a, b):
-        return a + b
-
     dirs = []
     try:
         sessions = {}
@@ -522,7 +528,8 @@ def reduce_wave_staged_bench(n_rows: int, dim: int = 16,
 
         def run_once(name):
             sess, read_shard = sessions[name]
-            r = bs.Reduce(bs.ReaderFunc(S, read_shard, out=schema), add)
+            r = bs.Reduce(bs.ReaderFunc(S, read_shard, out=schema),
+                          _add)
             res = sess.run(r)
             total = 0
             for f in res.frames():
@@ -560,6 +567,190 @@ def reduce_wave_staged_bench(n_rows: int, dim: int = 16,
     finally:
         for d in dirs:
             shutil.rmtree(d, ignore_errors=True)
+
+
+# ------------------------------------------------------------- serve-qps
+
+# Module-level pipeline state: the serve-qps bench registers ONE
+# pipeline whose slice builder (and combine fn) keep stable identity
+# and stable op site across sessions — the cross-Session program
+# cache keys on exactly that (op site + structure + fn content).
+_QPS_DATA = {}
+
+
+def _qps_pipeline():
+    import bigslice_tpu as bs
+
+    d = _QPS_DATA
+    return bs.Reduce(bs.Const(d["shards"], d["keys"], d["vals"]),
+                     _add)
+
+
+def serve_qps_bench(n_rows: int, seconds: float = 8.0,
+                    concurrency: int = 8, slots: int = 2):
+    """Sustained serving load against a live ServeServer (the
+    'heavy traffic' number): one resident server process, a waved
+    keyed-Reduce pipeline, measured over three phases —
+
+    1. **cold**: first invocation on a fresh process (pays every XLA
+       compile) on Session 1;
+    2. **warm-first**: the server swaps onto a FRESH Session 2 (same
+       process) and serves the same pipeline — the cross-Session
+       program cache must hand back every executable, so this request
+       performs **zero XLA compiles** (asserted from Session 2's
+       device telemetry; the acceptance criterion);
+    3. **sustained**: ``concurrency`` closed-loop HTTP clients (4
+       tenants) fire for ``seconds`` — QPS, p50/p99 latency, rows/sec,
+       shed count.
+
+    Returns the result dict the serve-qps JSON line carries."""
+    import json as json_mod
+    import threading
+    import urllib.request
+
+    import jax
+
+    from bigslice_tpu.exec.meshexec import MeshExecutor
+    from bigslice_tpu.exec.session import Session
+    from bigslice_tpu.serve.programcache import global_program_cache
+    from bigslice_tpu.serve.server import ServeServer
+
+    mesh = _mesh()
+    n = mesh.devices.size
+    S = 2 * max(1, int(n))  # waved: 2 waves per group
+    rng = np.random.RandomState(42)
+    _QPS_DATA.update(
+        shards=S,
+        keys=rng.randint(0, 1 << 12, n_rows).astype(np.int32),
+        vals=np.ones(n_rows, dtype=np.int32),
+    )
+
+    sess1 = Session(executor=MeshExecutor(mesh))
+    server = ServeServer(sess1, port=0, slots=slots,
+                         queue_depth=max(64, 4 * concurrency))
+    server.register("qps", _qps_pipeline,
+                    description="waved keyed Reduce (serve-qps)")
+
+    def invoke(tenant="bench", want_rows=False, timeout=300):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/serve/invoke",
+            data=json_mod.dumps({
+                "pipeline": "qps", "tenant": tenant,
+                "rows": want_rows,
+            }).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return json_mod.loads(r.read())
+
+    # Phase 1 — cold: the fresh process pays the compiles.
+    cold = invoke()
+    cold_s = cold["latency_s"]
+    t1 = (sess1.telemetry_summary().get("device") or {}).get(
+        "totals", {})
+    note(f"serve_qps cold: {cold_s * 1e3:.0f} ms "
+         f"({t1.get('compiles', 0)} XLA compiles, "
+         f"{t1.get('compile_s', 0)}s compile)")
+
+    # Phase 2 — fresh Session, same server process: the program cache
+    # must make this request compile-free.
+    pc0 = global_program_cache().stats()
+    sess2 = Session(executor=MeshExecutor(_mesh()))
+    server.attach_session(sess2)
+    sess1.shutdown()
+    warm = invoke()
+    warm_first_s = warm["latency_s"]
+    t2 = (sess2.telemetry_summary().get("device") or {}).get(
+        "totals", {})
+    pc1 = global_program_cache().stats()
+    cache_hits = pc1["hits"] - pc0["hits"]
+    if t2.get("fallbacks", 0):
+        raise RuntimeError(
+            f"AOT fallback during warm phase — compile accounting "
+            f"blind: {t2}"
+        )
+    if t2.get("compiles", 1) != 0 or cache_hits < 1:
+        raise RuntimeError(
+            f"fresh session was not compile-free: compiles="
+            f"{t2.get('compiles')} program-cache hits={cache_hits}"
+        )
+    note(f"serve_qps warm-first (fresh Session): "
+         f"{warm_first_s * 1e3:.0f} ms, 0 XLA compiles, "
+         f"{cache_hits} program-cache hits, "
+         f"{pc1['compile_s_saved'] - pc0['compile_s_saved']:.2f}s "
+         f"compile saved")
+
+    # Warm pass for the sustained phase (page in each client tenant).
+    invoke(tenant="t0")
+
+    # Phase 3 — sustained closed-loop load.
+    latencies = []
+    errors = []
+    lat_lock = threading.Lock()
+    stop_at = time.perf_counter() + seconds
+
+    def client(i):
+        tenant = f"t{i % 4}"
+        while time.perf_counter() < stop_at:
+            t0 = time.perf_counter()
+            try:
+                invoke(tenant=tenant)
+            except Exception as e:  # noqa: BLE001
+                with lat_lock:
+                    errors.append(repr(e))
+                return
+            with lat_lock:
+                latencies.append(time.perf_counter() - t0)
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(concurrency)]
+    t_start = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - t_start
+    if errors:
+        raise RuntimeError(f"serve_qps client errors: {errors[:3]}")
+    if not latencies:
+        raise RuntimeError("serve_qps: no requests completed")
+    ls = sorted(latencies)
+    # The server's own quantile helper: the bench's p50/p99 must agree
+    # with the self-reported /serve/stats quantiles by construction.
+    from bigslice_tpu.serve.server import _quantile
+
+    def q(p):
+        return _quantile(ls, p)
+
+    stats = server.serving_stats()
+    pc = stats["program_cache"]
+    out = {
+        "qps": len(ls) / elapsed,
+        "requests": len(ls),
+        "duration_s": round(elapsed, 3),
+        "concurrency": concurrency,
+        "slots": slots,
+        "rows_per_sec": n_rows * len(ls) / elapsed,
+        "p50_ms": round(q(0.5) * 1e3, 3),
+        "p99_ms": round(q(0.99) * 1e3, 3),
+        "cold_first_ms": round(cold_s * 1e3, 3),
+        "warm_first_ms": round(warm_first_s * 1e3, 3),
+        "warm_vs_cold": round(cold_s / warm_first_s, 3),
+        "fresh_session_compiles": t2.get("compiles", 0),
+        "fresh_session_cache_hits": cache_hits,
+        "program_cache_hit_rate": pc.get("hit_rate"),
+        "program_cache": {k: pc.get(k) for k in
+                          ("hits", "misses", "entries", "evictions",
+                           "compile_s_saved")},
+        "shed": stats["totals"].get("shed", 0),
+    }
+    note(f"serve_qps sustained: {out['qps']:.2f} req/s x {n_rows} "
+         f"rows ({out['rows_per_sec']:,.0f} rows/s), p50 "
+         f"{out['p50_ms']:.0f} ms p99 {out['p99_ms']:.0f} ms, "
+         f"{out['shed']} shed, program-cache hit rate "
+         f"{out['program_cache_hit_rate']}")
+    sess2.shutdown()  # drains the server (final snapshot on stderr)
+    return out
 
 
 # ------------------------------------------------------------------ join
@@ -614,9 +805,7 @@ def join_kernel_bench(n_rows: int, iters: int = 3):
 
     a_cols, a_counts = side(1)
     b_cols, b_counts = side(2)
-    j = join_mod.MeshJoinAggregate(
-        mesh, per, lambda x, y: x + y, lambda x, y: x + y
-    )
+    j = join_mod.MeshJoinAggregate(mesh, per, _add, _add)
 
     def run_once():
         out = j(a_cols, a_counts, b_cols, b_counts)
@@ -648,12 +837,9 @@ def join_e2e_bench(n_rows: int, iters: int = 3, dense: bool = False):
     ones = np.ones(n_rows, np.int32)
     dense_k = join_key_space(n_rows) if dense else None
 
-    def add(a, b):
-        return a + b
-
     def run_once():
         j = bs.JoinAggregate(
-            bs.Const(n, ak, ones), bs.Const(n, bk, ones), add, add,
+            bs.Const(n, ak, ones), bs.Const(n, bk, ones), _add, _add,
             dense_keys=dense_k,
         )
         res = sess.run(j)
@@ -1161,6 +1347,22 @@ def run_mode(mode: str, size, fallback: bool) -> None:
              staging_breakdown=fast_bd,
              legacy_overlap_efficiency=legacy_overlap,
              legacy_staging_breakdown=legacy_bd)
+    elif mode == "serve-qps":
+        # The serving plane's sustained-load number: a resident
+        # ServeServer fields concurrent HTTP invocations of a waved
+        # keyed Reduce; the warm phase runs on a FRESH Session whose
+        # programs come entirely from the cross-Session program cache
+        # (zero XLA compiles — enforced inside the bench). vs_baseline
+        # is the warm-vs-cold first-request latency ratio: the
+        # host-portable number for what the program cache buys.
+        n_rows = size or (1 << 18 if fallback else 1 << 20)
+        r = serve_qps_bench(n_rows,
+                            seconds=4.0 if fallback else 10.0,
+                            concurrency=4 if fallback else 8)
+        # vs_baseline == warm_vs_cold (emit divides value/baseline).
+        emit("serve_qps_req_per_sec", r["qps"], "req/sec",
+             r["qps"] / r["warm_vs_cold"],
+             **{k: v for k, v in r.items() if k != "qps"})
     elif mode == "staging":
         # Host-staging microbench: the BSF4 + arena + batched-put fast
         # path vs the BSF3 + concat + per-column-put legacy chain, on
@@ -1293,7 +1495,7 @@ def main():
     args = sys.argv[1:]
     known = ("reduce", "reduce-sort", "reduce-nohash", "reduce-dense",
              "reduce-wave", "reduce-wave-2d", "reduce-wave-staged",
-             "staging",
+             "staging", "serve-qps",
              "reduce-kernel", "join", "join-dense",
              "join-kernel", "wordcount", "sortshuffle", "cogroup",
              "kmeans", "attention", "matrix")

@@ -641,6 +641,19 @@ class MeshExecutor:
         self.store = _BridgedStore(self)
         self.local = LocalExecutor(procs=fallback_procs, store=self.store)
         self._lock = threading.Lock()
+        # THE shared wave slot (serving plane): one collective-bearing
+        # SPMD program in flight per executor. Concurrent evaluations
+        # (serve/server.py invocations, concurrent sess.run threads)
+        # interleave at WAVE granularity — dispatch through signal
+        # sync is atomic — because the CPU PJRT backend runs
+        # cross-device collectives through one worker pool whose
+        # rendezvous deadlocks when two collective programs' per-device
+        # executions interleave (each holds workers the other's
+        # rendezvous is waiting for). Host-side work (staging, store
+        # reads, readback, result scans) stays concurrent. Reentrant:
+        # the retry ladder, budget split, and auto-dense probe all
+        # re-enter on the owning thread.
+        self._wave_mutex = threading.RLock()
         self._groups: Dict[Tuple, _GroupState] = {}
         self._outputs: Dict[Tuple, DeviceGroupOutput] = {}
         self._task_index: Dict[TaskName, Tuple[Tuple, Task]] = {}
@@ -1579,7 +1592,8 @@ class MeshExecutor:
 
     def _obs_program(self, prog, kind: str, key_parts,
                      task: Optional[Task] = None,
-                     op: Optional[str] = None):
+                     op: Optional[str] = None,
+                     fns=None, extra=None):
         """The compile-telemetry seam: wrap a freshly-built jitted
         program so its first call per input signature is AOT-compiled
         (recording compile wall time + cost/memory analysis, keyed by
@@ -1588,7 +1602,16 @@ class MeshExecutor:
         the raw jit returns untouched (collection is no-op-cheap).
         Multiprocess SPMD meshes skip too: the AOT argument-sharding
         bake is per-process state and a per-process fallback would
-        diverge dispatch behavior across the gang."""
+        diverge dispatch behavior across the gang.
+
+        ``fns``/``extra`` feed the cross-Session program cache
+        (serve/programcache.py): ``fns`` is the complete list of user
+        functions the program closes over (``()`` for purely
+        structural helpers, ``None`` = never share across sessions),
+        ``extra`` is repr-stable serve-key-only material the
+        session-local digest omits (output schema, lowering-selection
+        bits). A long-lived server's fresh Sessions get their
+        executables back from that cache without touching XLA."""
         dev = self._device_telemetry()
         if dev is None or self.multiprocess:
             return prog
@@ -1606,7 +1629,7 @@ class MeshExecutor:
             else:
                 inv = None
             return dev.instrument(prog, op or kind, inv, kind,
-                                  key_parts)
+                                  key_parts, fns=fns, extra=extra)
         except Exception:
             return prog
 
@@ -1904,14 +1927,26 @@ class MeshExecutor:
                                             min(wait, stage_dur),
                                             wstats)
                 self._emit_phase(task0, PHASE_WAVE_COMPUTE, w)
-                inflight.append(
-                    (self._dispatch_wave(wave_tasks[w], w, inputs), w,
-                     time.perf_counter())
-                )
-                while len(inflight) > window:
-                    settle_one()
+                # Wave-slot atomicity: on the CPU backend window == 0,
+                # so dispatch + settle happen inside ONE mutex hold —
+                # a concurrent invocation can never interleave its
+                # collective program between this wave's launch and
+                # its signal sync (the rendezvous-deadlock shape). On
+                # TPU/GPU (window > 0) the per-process launch queue
+                # already serializes program execution, so holding the
+                # slot across the in-flight window isn't needed — the
+                # mutex only makes each dispatch/settle step atomic.
+                with self._wave_mutex:
+                    inflight.append(
+                        (self._dispatch_wave(wave_tasks[w], w,
+                                             inputs), w,
+                         time.perf_counter())
+                    )
+                    while len(inflight) > window:
+                        settle_one()
             while inflight:
-                settle_one()
+                with self._wave_mutex:
+                    settle_one()
             return outs
         finally:
             stop.set()
@@ -1979,22 +2014,26 @@ class MeshExecutor:
             # Serial staging: fully exposed (nothing overlapped it).
             self._telemetry_staging(task0, wave, dur, dur, wstats)
         t_run = time.perf_counter()
-        self._maybe_auto_dense(task0, inputs, wave)
-        budget = self.device_budget_bytes
-        out = None
-        if (budget
-                and task0.num_partition > 1
-                and len(inputs) == 1 and not inputs[0][3]
-                and self._splittable_chain(task0)
-                and self._wave_bytes_estimate(task0, inputs) > budget):
-            out = self._try_execute_wave_split(
-                tasks, wave, inputs, budget
-            )
-        if out is None:
-            out = self._execute_wave_on(
-                tasks, wave, inputs,
-                restage=lambda: self._group_inputs(tasks, wave),
-            )
+        # One wave slot: probe + (split) dispatch + signal sync are
+        # atomic against concurrent evaluations on this executor.
+        with self._wave_mutex:
+            self._maybe_auto_dense(task0, inputs, wave)
+            budget = self.device_budget_bytes
+            out = None
+            if (budget
+                    and task0.num_partition > 1
+                    and len(inputs) == 1 and not inputs[0][3]
+                    and self._splittable_chain(task0)
+                    and self._wave_bytes_estimate(task0, inputs)
+                    > budget):
+                out = self._try_execute_wave_split(
+                    tasks, wave, inputs, budget
+                )
+            if out is None:
+                out = self._execute_wave_on(
+                    tasks, wave, inputs,
+                    restage=lambda: self._group_inputs(tasks, wave),
+                )
         self._telemetry_compute(task0, wave,
                                 time.perf_counter() - t_run)
         return out
@@ -2109,7 +2148,8 @@ class MeshExecutor:
         # attributing it to the first builder's op would mis-credit
         # later sharers' compiles/hits (same for merge/subid/keyrange;
         # only _program's group key is op-specific).
-        prog = self._obs_program(prog, "rowslice", (dtypes, cap, B))
+        prog = self._obs_program(prog, "rowslice", (dtypes, cap, B),
+                                 fns=())
         with self._lock:
             self._programs[key] = (prog, ())
             while len(self._programs) > _PROGRAM_CACHE_MAX:
@@ -2212,6 +2252,18 @@ class MeshExecutor:
         # shuffle routes per device with a subid payload column.
         out_subid = task0.num_partition > self.nmesh
         ndest = min(task0.num_partition, self.nmesh)
+        self._wave_mutex.acquire()  # reentrant under _execute_wave
+        try:
+            return self._execute_wave_on_locked(
+                tasks, wave, inputs, first, restage, task0,
+                out_subid, ndest,
+            )
+        finally:
+            self._wave_mutex.release()
+
+    def _execute_wave_on_locked(self, tasks, wave, inputs, first,
+                                restage, task0, out_subid, ndest
+                                ) -> DeviceGroupOutput:
         while True:
             if first is not None:
                 # Settling a pipeline-dispatched attempt: sync ITS
@@ -2425,9 +2477,15 @@ class MeshExecutor:
                 tuple(range(W * (1 + ncols))) if donate else (),
             )
             # Kind-level attribution: shape-keyed shared cache (see
-            # the rowslice note).
+            # the rowslice note). The machine-combining variant closes
+            # over the user combine fn — content-fingerprinted for the
+            # cross-session key (plus its nkeys/nvals/subid config,
+            # which the trace branches on).
             prog = self._obs_program(
-                prog, "merge", (ncols, caps, dtypes, donate, bool(mc))
+                prog, "merge", (ncols, caps, dtypes, donate, bool(mc)),
+                fns=(fc.fn,) if mc else (),
+                extra=(fc.nkeys, fc.nvals, bool(has_subid))
+                if mc else None,
             )
             with self._lock:
                 self._programs[key] = (prog, ())
@@ -2527,7 +2585,8 @@ class MeshExecutor:
             body, mesh=self.mesh, in_specs=(P(axis), P(axis)),
             out_specs=P(axis), check_rep=False,
         ))
-        prog = self._obs_program(prog, "subid_count", (W, cap))
+        prog = self._obs_program(prog, "subid_count", (W, cap),
+                                 fns=())
         with self._lock:
             self._programs[key] = (prog, ())
             while len(self._programs) > _PROGRAM_CACHE_MAX:
@@ -2591,7 +2650,7 @@ class MeshExecutor:
             check_rep=False,
         ))
         prog = self._obs_program(prog, "subid_split",
-                                 (dtypes, W, cap, capr))
+                                 (dtypes, W, cap, capr), fns=())
         with self._lock:
             self._programs[key] = (prog, ())
             while len(self._programs) > _PROGRAM_CACHE_MAX:
@@ -3066,12 +3125,16 @@ class MeshExecutor:
                 out_specs=P(), check_rep=False,
             ))
             prog = self._obs_program(prog, "keyrange",
-                                     (int(capacity), bool(has_sub)))
+                                     (int(capacity), bool(has_sub)),
+                                     fns=())
             with self._lock:
                 self._programs[key] = (prog, ())
                 while len(self._programs) > _PROGRAM_CACHE_MAX:
                     self._programs.pop(next(iter(self._programs)))
-        mm = np.asarray(prog(counts, cols[kidx]))
+        # Collective program (pmin/pmax): dispatch + sync take the
+        # wave slot (reentrant when probed from inside a wave).
+        with self._wave_mutex:
+            mm = np.asarray(prog(counts, cols[kidx]))
         return int(mm[0]), int(mm[1])
 
     def _stages_for(self, task: Task) -> List[tuple]:
@@ -3745,14 +3808,26 @@ class MeshExecutor:
         )
         # Compile-telemetry seam: the op's SPMD group program, keyed by
         # the repr-stable half of the cache key (stage kinds, caps,
-        # partition config, slack/subid/donate signature) — the shape
-        # the future AOT program cache will key on.
+        # partition config, slack/subid/donate signature). ``fns`` +
+        # ``extra`` additionally key the cross-Session program cache
+        # (serve/programcache.py): the stage functions by content, the
+        # full repr-stable stage structure (dense key spaces, prefixes,
+        # discovered capacities the trace branched on), the output
+        # schema, and the hash-lowering bit — a fresh Session in the
+        # same server process whose pipeline matches all of it reuses
+        # this program's executable with zero XLA compiles.
         prog = self._obs_program(
             prog, "group",
             (tuple(k for k, _, _ in stages), caps,
              task.num_partition, self._input_ncols(task), slack,
              subids, donate),
             task=task,
+            fns=tuple(fns),
+            extra=(self._stage_struct(stages),
+                   tuple((str(ct.dtype), tuple(ct.shape))
+                         for ct in task.schema),
+                   len(task.schema),
+                   self._op_hash_engaged(task, stages)),
         )
         import weakref
 
@@ -3769,6 +3844,59 @@ class MeshExecutor:
             while len(self._programs) > _PROGRAM_CACHE_MAX:
                 self._programs.pop(next(iter(self._programs)))
         return prog, stages
+
+    @staticmethod
+    def _stage_struct(stages) -> tuple:
+        """Repr-stable stage descriptors for the cross-Session program
+        key (serve/programcache.py): the session-local struct ids with
+        every ``id(fn)`` removed — function *content* is fingerprinted
+        separately from ``_stage_fns`` order, so two sessions whose
+        pipelines differ only in function object identity (the normal
+        fresh-Session case) share a key, while any structural knob the
+        trace branches on (dense key spaces, prefixes, shard counts,
+        capacities) still splits it."""
+        out = []
+        for kind, sid, s in stages:
+            if kind == "map":
+                out.append((kind, len(s.args)))
+            elif kind == "flatmap":
+                out.append((kind, s.fanout))
+            elif kind == "filter":
+                out.append((kind,))
+            elif kind in ("head", "groupby", "attend", "cogroup"):
+                # These struct ids are already id()-free (scalars,
+                # dtypes, discovered capacities) — pass them through.
+                out.append((kind, sid))
+            elif kind == "combine":
+                fc = s.frame_combiner
+                out.append((kind, fc.nkeys, fc.nvals,
+                            getattr(fc, "dense_keys", None)))
+            elif kind == "fold":
+                out.append((kind, s.prefix, repr(s.init),
+                            str(s.acc_dtype),
+                            getattr(s, "dense_keys", None)))
+            elif kind == "join":
+                fa, fb = s.frame_combiners
+                out.append((kind, s.prefix,
+                            getattr(fa, "nkeys", None), fa.nvals,
+                            fb.nvals,
+                            getattr(fa, "dense_keys", None),
+                            getattr(fb, "dense_keys", None),
+                            s.num_shards))
+            elif kind == "shuffle":
+                fc = s.partitioner.combiner
+                out.append((kind, s.schema.prefix, fc is not None,
+                            s.partitioner.partition_fn is not None,
+                            s.num_partition,
+                            getattr(fc, "dense_keys", None)
+                            if fc else None,
+                            getattr(fc, "nkeys", None)
+                            if fc else None,
+                            getattr(fc, "nvals", None)
+                            if fc else None))
+            else:  # future stage kinds: unknown structure, key on kind
+                out.append((kind, "opaque"))
+        return tuple(out)
 
     @staticmethod
     def _stage_fns(stages) -> list:

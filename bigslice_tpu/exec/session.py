@@ -319,6 +319,10 @@ class Session:
             monitors.append(self._event_monitor)
         self.monitor = status_mod.chain_monitors(*monitors)
         self.machine_combiners = machine_combiners
+        # Serving plane (serve/server.py): a ServeServer attached to
+        # this session sets itself here so shutdown() can drain
+        # in-flight invocations BEFORE the executor goes away.
+        self.serve = None
         self.debug = None
         if debug_port is not None:
             from bigslice_tpu.utils.debughttp import DebugServer
@@ -630,6 +634,17 @@ class Session:
     must = run
 
     def shutdown(self) -> None:
+        # Drain the serving surface FIRST: in-flight invocations are
+        # evaluating on this session's executor, so the server must
+        # stop admitting and let them finish before the executor (and
+        # its mesh state) is torn down — the SIGTERM half of the
+        # serving plane's graceful-shutdown contract (the server's
+        # close() also flushes its final telemetry snapshot).
+        if self.serve is not None:
+            try:
+                self.serve.close()
+            except Exception:
+                pass
         close = getattr(self.executor, "close", None)
         if close is not None:
             close()

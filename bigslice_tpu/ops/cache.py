@@ -15,6 +15,7 @@ via utils/fileio — the reference's S3-capable cache contract.
 
 from __future__ import annotations
 
+import threading
 from typing import Optional
 
 from bigslice_tpu import typecheck
@@ -26,6 +27,35 @@ from bigslice_tpu.utils import fileio
 
 def shard_path(prefix: str, shard: int, num_shards: int) -> str:
     return f"{prefix}-{shard:04d}-of-{num_shards:04d}"
+
+
+# Process-scope hit/miss accounting: when the serving plane wires a
+# cache prefix under a pipeline (serve/server.py's cross-request result
+# cache), its effectiveness must be a measured number — the telemetry
+# hub surfaces these as telemetry_summary()["result_cache"] and
+# Prometheus ``bigslice_result_cache_total{outcome}``. Counted per
+# shard read (a hit is a shard served from the cache file, a miss is a
+# shard computed and written through).
+_rc_lock = threading.Lock()
+_rc_counts = {"hit": 0, "miss": 0}
+
+
+def _record_result_cache(outcome: str) -> None:
+    with _rc_lock:
+        _rc_counts[outcome] = _rc_counts.get(outcome, 0) + 1
+
+
+def result_cache_counts() -> dict:
+    """Snapshot of the process-wide result-cache outcome counters."""
+    with _rc_lock:
+        return dict(_rc_counts)
+
+
+def reset_result_cache_counts() -> None:
+    """Zero the counters (tests)."""
+    with _rc_lock:
+        for k in list(_rc_counts):
+            _rc_counts[k] = 0
 
 
 class ShardCache:
@@ -115,7 +145,9 @@ class _CachedSlice(Slice):
 
     def reader(self, shard, deps):
         if self._shard_cached(shard):
+            _record_result_cache("hit")
             return self.cache.read(shard)
+        _record_result_cache("miss")
         return self.cache.writethrough(shard, deps[0]())
 
 
@@ -139,6 +171,7 @@ class _ReadCacheSlice(Slice):
         self.cache = cache
 
     def reader(self, shard, deps):
+        _record_result_cache("hit")
         return self.cache.read(shard)
 
 

@@ -1,0 +1,338 @@
+"""Cross-Session compiled-program cache — the serving plane's hot seam.
+
+PR 6's device-telemetry wrapper (`utils/devicetelemetry.py`) already
+AOT-compiles every SPMD program the mesh executor builds and reuses the
+held executable for the lifetime of that wrapper — but wrappers live in
+the executor's per-session program dict, so **every new Session
+recompiles from zero**. For a long-lived server that owns the mesh and
+fields pipeline invocations, XLA compile time IS the cold-start tail:
+this module holds the compiled executables at *process* scope, so a
+fresh Session whose executor builds the structurally-identical program
+gets the executable back without touching XLA.
+
+Key design (what makes cross-session reuse *sound*):
+
+- The executor's session-local program key embeds ``id()``s of the
+  user stage functions — valid within a process run of one session,
+  meaningless across sessions. The cross-session key instead folds a
+  **content fingerprint** of every user function the program closes
+  over (bytecode + consts + names + closure cell values, recursively
+  for nested functions). Anything that defeats fingerprinting — a
+  closure over an array, an exotic callable — makes the program
+  *session-local only*: it still AOT-caches inside its wrapper exactly
+  as before, it just never enters this cache. Correctness never
+  depends on the fingerprint being clever.
+- The rest of the key is the digest the PR-6 seam was designed to
+  become: op **site** (file:line, the ``#N`` re-invocation suffix
+  stripped — iterative drivers and fresh sessions mint new suffixes
+  for the same pipeline), program kind, the repr-stable structural
+  key (stage kinds, capacities, partition config, slack/subid/donate
+  signature, mesh-topology signature), plus the per-call argument
+  signature (shapes, dtypes, shardings) the AOT executable was baked
+  for.
+- Entries are (executable, compile seconds). Capacity is bounded
+  (LRU); hits, misses, insertions, evictions, and compile-seconds
+  saved/evicted are all counted and surfaced through the telemetry
+  hub (``telemetry_summary()["program_cache"]``) and Prometheus
+  (``bigslice_program_cache_total{outcome}``).
+
+``BIGSLICE_PROGRAM_CACHE`` sets the capacity in entries (default 128);
+``0``/``off`` disables the cross-session tier entirely — the chicken
+bit that restores per-session behavior bit-identically.
+"""
+
+from __future__ import annotations
+
+import collections
+import hashlib
+import os
+import threading
+from typing import Optional, Tuple
+
+DEFAULT_CAPACITY = 128
+
+# Primitive const/cell types whose repr is stable and content-complete.
+_PRIMITIVES = (type(None), bool, int, float, complex, str, bytes)
+
+
+class Unfingerprintable(Exception):
+    """A function closes over state we cannot stably fingerprint."""
+
+
+def _const_token(v, depth: int):
+    """Stable token for one code const / closure cell / default value.
+    Raises Unfingerprintable for anything whose repr could embed a
+    memory address or mutate between sessions."""
+    if isinstance(v, _PRIMITIVES):
+        return repr(v)
+    if isinstance(v, (tuple, frozenset)):
+        return (type(v).__name__,
+                tuple(_const_token(x, depth) for x in v))
+    code = getattr(v, "co_code", None)
+    if code is not None:  # nested code object (comprehension, lambda)
+        return _code_token(v, depth)
+    raise Unfingerprintable(type(v).__name__)
+
+
+def _code_token(code, depth: int):
+    if depth > 8:
+        raise Unfingerprintable("nesting too deep")
+    return (
+        "code",
+        code.co_name,
+        code.co_argcount,
+        code.co_flags,
+        code.co_code.hex(),
+        tuple(_const_token(c, depth + 1) for c in code.co_consts),
+        code.co_names,
+        code.co_varnames,
+        code.co_freevars,
+    )
+
+
+def _global_tokens(fn, code, depth: int) -> tuple:
+    """Value tokens for the module globals a function reads. Closure
+    cells and defaults are value-hashed; globals must be too, or two
+    sessions could share an executable traced against different global
+    values (same bytecode, same names — stale results). ``co_names``
+    mixes global loads with attribute names, so only names that
+    actually resolve in ``fn.__globals__`` count (builtins and
+    attribute names are skipped — stable by construction). Modules
+    hash by name (numpy/jnp are stable libraries; this mirrors jit's
+    own globals-are-stable trace semantics one level down); functions
+    recurse; primitives hash by value; anything else — mutable objects,
+    arrays — is Unfingerprintable, keeping the program session-local."""
+    g = getattr(fn, "__globals__", None)
+    if g is None:
+        return ()
+    names = set(code.co_names)
+    stack = list(code.co_consts)
+    while stack:  # nested code objects read globals too
+        c = stack.pop()
+        if hasattr(c, "co_names"):
+            names.update(c.co_names)
+            stack.extend(c.co_consts)
+    out = []
+    for name in sorted(names):
+        if name not in g:
+            continue  # builtin or attribute name: stable
+        v = g[name]
+        if isinstance(v, type(os)):  # module
+            out.append((name, "module", v.__name__))
+        elif callable(v) and getattr(v, "__code__", None) is not None:
+            out.append((name, _fn_token(v, depth + 1)))
+        else:
+            out.append((name, _const_token(v, depth + 1)))
+    return tuple(out)
+
+
+def _fn_token(fn, depth: int = 0):
+    if depth > 8:
+        raise Unfingerprintable("nesting too deep")
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        raise Unfingerprintable(type(fn).__name__)
+    cells = ()
+    if fn.__closure__:
+        cells = tuple(
+            _cell_token(c.cell_contents, depth + 1)
+            for c in fn.__closure__
+        )
+    defaults = ()
+    if fn.__defaults__:
+        defaults = tuple(
+            _cell_token(d, depth + 1) for d in fn.__defaults__
+        )
+    return ("fn", getattr(fn, "__qualname__", fn.__name__),
+            _code_token(code, depth), cells, defaults,
+            _global_tokens(fn, code, depth))
+
+
+def _cell_token(v, depth: int):
+    """Closure cells / defaults may hold other functions (combiner
+    factories): recurse; otherwise primitives only."""
+    if callable(v) and getattr(v, "__code__", None) is not None:
+        return _fn_token(v, depth)
+    return _const_token(v, depth)
+
+
+def fn_fingerprint(fns) -> Optional[tuple]:
+    """Content fingerprint of the user functions a compiled program
+    closes over: the cross-session half of the cache key. ``fns`` is a
+    sequence of callables (empty = a purely structural program, always
+    fingerprintable). Returns None when any function defeats stable
+    fingerprinting — the caller must then keep the program
+    session-local."""
+    try:
+        return tuple(_fn_token(f) for f in fns)
+    except Exception:
+        return None
+
+
+def serve_digest(op: str, kind: str, key_parts, extra,
+                 fingerprint: tuple) -> str:
+    """The cross-session program identity: op SITE (the compiler's
+    ``#N`` re-invocation suffix stripped), program kind, the
+    repr-stable structural key (which already folds the mesh-topology
+    signature at the meshexec call sites), serve-only extra key parts
+    (output schema, lowering-selection bits), and the user-fn content
+    fingerprint. ``key_parts``/``extra`` must be repr-stable (no
+    ids)."""
+    site = op.split("#", 1)[0]
+    payload = repr((site, kind, key_parts, extra, fingerprint)).encode()
+    return hashlib.sha1(payload).hexdigest()
+
+
+def cache_capacity() -> int:
+    """Configured capacity in entries; 0 disables the cross-session
+    tier (``BIGSLICE_PROGRAM_CACHE=0``/``off`` is the chicken bit)."""
+    raw = os.environ.get("BIGSLICE_PROGRAM_CACHE", "").strip().lower()
+    if raw in ("", None):
+        return DEFAULT_CAPACITY
+    if raw in ("off", "false", "no"):
+        return 0
+    try:
+        return max(0, int(raw))
+    except ValueError:
+        return DEFAULT_CAPACITY
+
+
+class ProgramCache:
+    """Bounded process-scope LRU of AOT-compiled XLA executables.
+
+    Keys are ``(digest, arg_signature)`` — the serve digest above plus
+    the per-call (shape, dtype, sharding) tuple the executable's input
+    layout was baked for. Values are ``(executable, compile_s)``.
+    Thread-safe; all accounting is O(1) under one lock. Evicting an
+    entry only drops this cache's reference — live wrappers keep
+    theirs, so an executable mid-flight is never yanked."""
+
+    def __init__(self, capacity: Optional[int] = None):
+        self._lock = threading.Lock()
+        self._entries: "collections.OrderedDict[Tuple, Tuple]" = \
+            collections.OrderedDict()
+        self._capacity = (cache_capacity() if capacity is None
+                          else max(0, int(capacity)))
+        self.hits = 0
+        self.misses = 0
+        self.inserts = 0
+        self.evictions = 0
+        self.discards = 0
+        self.compile_s_saved = 0.0     # compile seconds hits avoided
+        self.compile_s_held = 0.0      # invested in live entries
+        self.compile_s_evicted = 0.0   # invested then evicted
+
+    @property
+    def enabled(self) -> bool:
+        return self._capacity > 0
+
+    def get(self, digest: str, sig: tuple):
+        """The compiled executable for (digest, sig), or None. A hit
+        refreshes recency and credits the entry's compile seconds to
+        ``compile_s_saved`` (the number the serving plane advertises:
+        XLA time the resident cache spared fresh sessions)."""
+        if not self.enabled:
+            return None
+        key = (digest, sig)
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                self.misses += 1
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+            self.compile_s_saved += entry[1]
+            return entry[0]
+
+    def put(self, digest: str, sig: tuple, compiled,
+            compile_s: float) -> None:
+        if not self.enabled:
+            return
+        key = (digest, sig)
+        compile_s = max(0.0, float(compile_s))
+        with self._lock:
+            if key not in self._entries:
+                self.inserts += 1
+                self.compile_s_held += compile_s
+            self._entries[key] = (compiled, compile_s)
+            self._entries.move_to_end(key)
+            while len(self._entries) > self._capacity:
+                _, (_, ev_s) = self._entries.popitem(last=False)
+                self.evictions += 1
+                self.compile_s_held -= ev_s
+                self.compile_s_evicted += ev_s
+
+    def discard(self, digest: str, sig: tuple) -> None:
+        """Invalidate one entry (a wrapper's baked executable was
+        rejected at call time — the entry must not keep fanning out to
+        future sessions)."""
+        with self._lock:
+            entry = self._entries.pop((digest, sig), None)
+            if entry is not None:
+                self.discards += 1
+                self.compile_s_held -= entry[1]
+
+    def clear(self) -> None:
+        """Drop every held executable (tests; mesh teardown)."""
+        with self._lock:
+            self._entries.clear()
+            self.compile_s_held = 0.0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def stats(self) -> dict:
+        with self._lock:
+            n = self.hits + self.misses
+            return {
+                "enabled": self.enabled,
+                "capacity": self._capacity,
+                "entries": len(self._entries),
+                "hits": self.hits,
+                "misses": self.misses,
+                "hit_rate": round(self.hits / n, 4) if n else None,
+                "inserts": self.inserts,
+                "evictions": self.evictions,
+                "discards": self.discards,
+                "compile_s_saved": round(self.compile_s_saved, 6),
+                "compile_s_held": round(self.compile_s_held, 6),
+                "compile_s_evicted": round(self.compile_s_evicted, 6),
+            }
+
+
+_global_lock = threading.Lock()
+_global: Optional[ProgramCache] = None
+
+
+def global_program_cache() -> ProgramCache:
+    """The process-wide cache every instrumented program probes.
+    Capacity is read from ``BIGSLICE_PROGRAM_CACHE`` at first use;
+    tests that flip the env var should construct their own
+    ``ProgramCache`` or call ``reset_global_program_cache()``."""
+    global _global
+    with _global_lock:
+        if _global is None:
+            _global = ProgramCache()
+        return _global
+
+
+def reset_global_program_cache() -> None:
+    """Drop the singleton (tests): the next ``global_program_cache()``
+    re-reads the capacity knob and starts with empty accounting."""
+    global _global
+    with _global_lock:
+        old, _global = _global, None
+    if old is not None:
+        old.clear()
+
+
+def program_cache_stats() -> dict:
+    """The stats dict the telemetry hub surfaces as
+    ``telemetry_summary()["program_cache"]`` — zero-valued (but
+    present) before the first program is ever instrumented."""
+    with _global_lock:
+        cache = _global
+    if cache is None:
+        return ProgramCache(capacity=cache_capacity()).stats()
+    return cache.stats()

@@ -1,0 +1,557 @@
+"""The invocation server: a long-lived, multi-tenant serving surface.
+
+Grows ``utils/debughttp.py``'s request plumbing into the SNIPPETS
+north star — ``exec.Start(exec.TPU)`` with pipelines served from a
+resident process that owns the mesh:
+
+- **Named pipelines** (the deterministic ``bigslice.Func`` framing):
+  the operator registers ``name -> Func | slice-returning callable``
+  at startup; invocations arrive as HTTP/JSON ``POST /serve/invoke``
+  with ``{"pipeline", "args", "tenant"}``.
+- **Shared wave slots + admission control**: at most ``slots``
+  invocations evaluate concurrently on the shared Session (its
+  invocation gate keeps them isolated; the program/result caches make
+  them cheap); at most ``queue_depth`` more wait. Beyond that the
+  server *sheds* with 503 instead of queuing unboundedly, and a
+  tenant above its ``tenant_quota`` of in-flight+queued invocations
+  gets 429 — one noisy tenant cannot starve the rest.
+- **Per-tenant metrics**: requests/outcomes, latency quantiles, rows
+  served — surfaced as ``telemetry_summary()["serving"]``, Prometheus
+  (``bigslice_serving_*`` on ``/debug/metrics``), and
+  ``GET /serve/stats``.
+- **Cross-request result cache**: a pipeline registered with
+  ``cache=True`` runs under ``ops/cache.py``'s writethrough tier,
+  keyed by (pipeline, args digest) below ``result_cache_dir`` —
+  repeat invocations are file reads, with hit/miss accounting
+  (``bigslice_result_cache_total{outcome}``).
+- **Session swap**: ``attach_session()`` moves the server onto a
+  fresh Session (elastic recovery, config rollover) — the
+  cross-Session program cache (serve/programcache.py) makes the swap
+  cheap: the new Session's programs come back as held executables,
+  zero XLA compiles.
+- **Graceful shutdown**: ``close()`` rejects new work, drains
+  in-flight invocations (bounded), flushes a final telemetry snapshot
+  (StatusPrinter-style), then releases the socket. SIGTERM in
+  ``tools/sliceserve.py`` lands here.
+
+The debug surface (``/debug/*``) rides on the same listener via the
+``DebugServer`` base class.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+from bigslice_tpu.utils.debughttp import DebugServer
+
+# Bounded per-tenant latency samples (quantiles stay meaningful, a
+# week of traffic doesn't grow the server).
+MAX_LATENCY_SAMPLES = 4096
+
+# Rows returned inline per invocation unless the caller asks for
+# fewer; bounds response payloads, not the computation.
+DEFAULT_MAX_ROWS = 4096
+
+
+def _quantile(sorted_xs: List[float], p: float) -> float:
+    n = len(sorted_xs)
+    if n == 0:
+        return 0.0
+    if n == 1:
+        return sorted_xs[0]
+    i = p * (n - 1)
+    lo = int(i)
+    hi = min(lo + 1, n - 1)
+    return sorted_xs[lo] + (sorted_xs[hi] - sorted_xs[lo]) * (i - lo)
+
+
+def _jsonable(v):
+    """Result-row cell → JSON-serializable (numpy scalars/vectors)."""
+    if hasattr(v, "tolist"):
+        return v.tolist()
+    if hasattr(v, "item"):
+        return v.item()
+    return v
+
+
+class Pipeline:
+    """One registered pipeline: a ``Func`` or a slice-returning
+    callable, plus its serving options."""
+
+    def __init__(self, name: str, fn, cache: bool = False,
+                 description: str = ""):
+        self.name = name
+        self.fn = fn
+        self.cache = cache
+        self.description = (description
+                           or (getattr(fn, "__doc__", None) or ""
+                               ).strip().split("\n")[0])
+
+
+class _TenantRecord:
+    def __init__(self):
+        self.requests = 0
+        self.outcomes: Dict[str, int] = {}
+        self.latencies: List[float] = []
+        self.rows = 0
+        self.inflight = 0  # active + queued right now
+
+
+class ServingStats:
+    """Per-tenant serving accounting, hub-attachable: the telemetry
+    hub surfaces ``summary()`` as ``telemetry_summary()["serving"]``
+    and ``prometheus_lines()`` under ``/debug/metrics``."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._tenants: Dict[str, _TenantRecord] = {}
+        self.active = 0
+        self.queued = 0
+        self.shed_total = 0
+
+    def _tenant(self, tenant: str) -> _TenantRecord:
+        rec = self._tenants.get(tenant)
+        if rec is None:
+            rec = self._tenants[tenant] = _TenantRecord()
+        return rec
+
+    def record(self, tenant: str, outcome: str,
+               latency_s: Optional[float] = None,
+               rows: int = 0) -> None:
+        with self._lock:
+            rec = self._tenant(tenant)
+            rec.requests += 1
+            rec.outcomes[outcome] = rec.outcomes.get(outcome, 0) + 1
+            if outcome.startswith("rejected"):
+                self.shed_total += 1
+            if latency_s is not None:
+                if len(rec.latencies) >= MAX_LATENCY_SAMPLES:
+                    rec.latencies.pop(0)
+                rec.latencies.append(latency_s)
+            rec.rows += max(0, int(rows))
+
+    def tenant_inflight(self, tenant: str) -> int:
+        with self._lock:
+            rec = self._tenants.get(tenant)
+            return rec.inflight if rec else 0
+
+    def adjust_inflight(self, tenant: str, delta: int) -> None:
+        with self._lock:
+            self._tenant(tenant).inflight += delta
+
+    def summary(self) -> dict:
+        with self._lock:
+            tenants = {}
+            tot_requests = tot_rows = 0
+            all_lats: List[float] = []
+            for name, rec in self._tenants.items():
+                ls = sorted(rec.latencies)
+                entry = {
+                    "requests": rec.requests,
+                    "outcomes": dict(rec.outcomes),
+                    "rows": rec.rows,
+                    "inflight": rec.inflight,
+                }
+                if ls:
+                    entry["latency"] = {
+                        "n": len(ls),
+                        "p50_s": round(_quantile(ls, 0.5), 6),
+                        "p99_s": round(_quantile(ls, 0.99), 6),
+                        "max_s": round(ls[-1], 6),
+                    }
+                tenants[name] = entry
+                tot_requests += rec.requests
+                tot_rows += rec.rows
+                all_lats.extend(ls)
+            all_lats.sort()
+            out = {
+                "tenants": tenants,
+                "totals": {
+                    "requests": tot_requests,
+                    "rows": tot_rows,
+                    "shed": self.shed_total,
+                    "active": self.active,
+                    "queued": self.queued,
+                },
+            }
+            if all_lats:
+                out["totals"]["latency"] = {
+                    "n": len(all_lats),
+                    "p50_s": round(_quantile(all_lats, 0.5), 6),
+                    "p99_s": round(_quantile(all_lats, 0.99), 6),
+                }
+            return out
+
+    def prometheus_lines(self, metric, line) -> None:
+        with self._lock:
+            tenants = {
+                name: (dict(rec.outcomes), sorted(rec.latencies),
+                       rec.rows)
+                for name, rec in self._tenants.items()
+            }
+            active, queued = self.active, self.queued
+        metric("bigslice_serving_requests_total",
+               "Pipeline invocations by tenant and outcome "
+               "(serve/server.py admission + execution).", "counter")
+        for name, (outcomes, _, _) in tenants.items():
+            for outcome, n in sorted(outcomes.items()):
+                line("bigslice_serving_requests_total",
+                     {"tenant": name, "outcome": outcome}, n)
+        metric("bigslice_serving_latency_seconds",
+               "Invocation latency quantiles per tenant (admission to "
+               "response).", "summary")
+        for name, (_, ls, _) in tenants.items():
+            if not ls:
+                continue
+            for q in (0.5, 0.99):
+                line("bigslice_serving_latency_seconds",
+                     {"tenant": name, "quantile": str(q)},
+                     f"{_quantile(ls, q):.6f}")
+            line("bigslice_serving_latency_seconds_count",
+                 {"tenant": name}, len(ls))
+            line("bigslice_serving_latency_seconds_sum",
+                 {"tenant": name}, f"{sum(ls):.6f}")
+        metric("bigslice_serving_rows_total",
+               "Result rows served per tenant.", "counter")
+        for name, (_, _, rows) in tenants.items():
+            if rows:
+                line("bigslice_serving_rows_total", {"tenant": name},
+                     rows)
+        metric("bigslice_serving_inflight",
+               "Invocations currently evaluating (active) or waiting "
+               "for a wave slot (queued).", "gauge")
+        line("bigslice_serving_inflight", {"state": "active"}, active)
+        line("bigslice_serving_inflight", {"state": "queued"}, queued)
+
+
+class ServeServer(DebugServer):
+    """HTTP serving front end over one shared Session (see module
+    docstring). ``slots`` bounds concurrent evaluations, ``queue_depth``
+    bounds waiters (beyond → 503), ``tenant_quota`` bounds one
+    tenant's in-flight+queued invocations (beyond → 429; ``None`` =
+    unlimited)."""
+
+    def __init__(self, session, port: int = 0, slots: int = 2,
+                 queue_depth: int = 16,
+                 tenant_quota: Optional[int] = None,
+                 result_cache_dir: Optional[str] = None,
+                 default_tenant: str = "default"):
+        self._pipelines: Dict[str, Pipeline] = {}
+        self._pipe_lock = threading.Lock()
+        self.slots = max(1, int(slots))
+        self.queue_depth = max(0, int(queue_depth))
+        self.tenant_quota = tenant_quota
+        self.result_cache_dir = result_cache_dir
+        self.default_tenant = default_tenant
+        self.stats = ServingStats()
+        # Admission state: one lock guards the active/queued counters
+        # (decisions must be atomic — a race could admit past the
+        # bound); a Condition hands freed slots to waiters FIFO-ish.
+        self._adm = threading.Condition()
+        self._started = time.time()
+        super().__init__(session, port)
+        self._hook_session(session)
+
+    # -- session attachment ----------------------------------------------
+
+    def _hook_session(self, session) -> None:
+        hub = getattr(session, "telemetry", None)
+        if hub is not None:
+            hub.serving = self.stats
+        setattr(session, "serve", self)
+
+    def attach_session(self, session) -> None:
+        """Swap the server onto a fresh Session (same process — the
+        cross-Session program cache keeps the swap compile-free).
+        In-flight invocations keep the Session they started on."""
+        old = self.session
+        with self._adm:
+            self.session = session
+        self._hook_session(session)
+        if old is not None and getattr(old, "serve", None) is self:
+            old.serve = None
+
+    # -- pipeline registry -------------------------------------------------
+
+    def register(self, name: str, fn, cache: bool = False,
+                 description: str = "") -> Pipeline:
+        """Register ``name`` → a ``Func`` or slice-returning callable.
+        ``cache=True`` runs invocations under the ops/cache.py
+        writethrough tier keyed by (name, args digest) below
+        ``result_cache_dir``."""
+        from bigslice_tpu import typecheck
+
+        typecheck.check(callable(fn),
+                        "serve.register(%s): fn must be callable", name)
+        if cache and not self.result_cache_dir:
+            raise ValueError(
+                f"pipeline {name}: cache=True needs a "
+                f"result_cache_dir on the server"
+            )
+        pipe = Pipeline(name, fn, cache=cache, description=description)
+        with self._pipe_lock:
+            self._pipelines[name] = pipe
+        return pipe
+
+    def pipelines(self) -> dict:
+        with self._pipe_lock:
+            return {
+                name: {"description": p.description,
+                       "cache": p.cache}
+                for name, p in self._pipelines.items()
+            }
+
+    # -- HTTP routes -------------------------------------------------------
+
+    def index_lines(self) -> List[str]:
+        return [
+            "bigslice_tpu serving plane",
+            "",
+            "POST /serve/invoke  {\"pipeline\", \"args\", \"tenant\"}"
+            "  run a registered pipeline",
+            "GET  /serve/pipelines  registered pipelines (json)",
+            "GET  /serve/stats  per-tenant serving stats + program/"
+            "result cache (json)",
+            "GET  /healthz  liveness (json)",
+            "",
+        ] + super().index_lines()
+
+    def handle_get(self, handler, parsed) -> bool:
+        path = parsed.path
+        if path in ("/serve", "/serve/"):
+            handler._send(200, "text/plain",
+                          "\n".join(self.index_lines()) + "\n")
+        elif path == "/serve/pipelines":
+            handler._send_json(200, self.pipelines())
+        elif path == "/serve/stats":
+            handler._send_json(200, self.serving_stats())
+        elif path == "/healthz":
+            handler._send_json(200, {
+                "ok": True,
+                "uptime_s": round(time.time() - self._started, 3),
+                "pipelines": sorted(self.pipelines()),
+            })
+        else:
+            return super().handle_get(handler, parsed)
+        return True
+
+    def handle_post(self, handler, parsed) -> bool:
+        if parsed.path != "/serve/invoke":
+            return super().handle_post(handler, parsed)
+        body = handler._read_body()
+        if body is None:
+            handler._send_json(413, {"error": "request body too "
+                                              "large"})
+            return True
+        try:
+            req = json.loads(body or b"{}")
+        except ValueError:
+            handler._send_json(400, {"error": "invalid JSON body"})
+            return True
+        code, doc = self.invoke_request(req)
+        handler._send_json(code, doc)
+        return True
+
+    # -- invocation path ---------------------------------------------------
+
+    def serving_stats(self) -> dict:
+        from bigslice_tpu.ops.cache import result_cache_counts
+        from bigslice_tpu.serve.programcache import (
+            program_cache_stats,
+        )
+
+        doc = self.stats.summary()
+        doc["program_cache"] = program_cache_stats()
+        doc["result_cache"] = result_cache_counts()
+        doc["admission"] = {
+            "slots": self.slots,
+            "queue_depth": self.queue_depth,
+            "tenant_quota": self.tenant_quota,
+        }
+        return doc
+
+    def invoke_request(self, req: dict):
+        """The full admission + execution path for one invocation
+        request (the HTTP handler and tests call this directly).
+        Returns ``(http_status, response_doc)``."""
+        name = req.get("pipeline")
+        args = req.get("args") or []
+        tenant = str(req.get("tenant") or self.default_tenant)
+        want_rows = bool(req.get("rows", True))
+        try:
+            max_rows = int(req.get("max_rows", DEFAULT_MAX_ROWS))
+        except (TypeError, ValueError):
+            return 400, {"error": "max_rows must be an integer"}
+        if not isinstance(args, list):
+            return 400, {"error": "args must be a JSON array"}
+        with self._pipe_lock:
+            pipe = self._pipelines.get(name)
+        if pipe is None:
+            return 404, {
+                "error": f"unknown pipeline {name!r}",
+                "pipelines": sorted(self.pipelines()),
+            }
+
+        # -- admission (atomic under the condition's lock) ------------
+        with self._adm:
+            if self._closing:
+                self.stats.record(tenant, "rejected_closing")
+                return 503, {"error": "shutting down"}
+            if (self.tenant_quota is not None
+                    and self.stats.tenant_inflight(tenant)
+                    >= self.tenant_quota):
+                self.stats.record(tenant, "rejected_quota")
+                return 429, {
+                    "error": f"tenant {tenant!r} is at its quota of "
+                             f"{self.tenant_quota} in-flight "
+                             f"invocations",
+                    "retry": True,
+                }
+            if (self.stats.active >= self.slots
+                    and self.stats.queued >= self.queue_depth):
+                self.stats.record(tenant, "rejected_capacity")
+                return 503, {
+                    "error": f"admission queue full "
+                             f"({self.slots} slots + "
+                             f"{self.queue_depth} queued)",
+                    "retry": True,
+                }
+            self.stats.adjust_inflight(tenant, +1)
+            if self.stats.active < self.slots:
+                self.stats.active += 1
+            else:
+                self.stats.queued += 1
+                while self.stats.active >= self.slots:
+                    self._adm.wait()
+                    if self._closing:
+                        self.stats.queued -= 1
+                        self.stats.adjust_inflight(tenant, -1)
+                        self.stats.record(tenant, "rejected_closing")
+                        return 503, {"error": "shutting down"}
+                self.stats.queued -= 1
+                self.stats.active += 1
+
+        t0 = time.perf_counter()
+        try:
+            doc = self._run(pipe, args, want_rows, max_rows)
+        except Exception as e:  # noqa: BLE001 — serve errors as JSON
+            latency = time.perf_counter() - t0
+            self.stats.record(tenant, "error", latency)
+            return 500, {
+                "error": f"{type(e).__name__}: {e}",
+                "pipeline": name,
+                "latency_s": round(latency, 6),
+            }
+        finally:
+            with self._adm:
+                self.stats.active -= 1
+                self.stats.adjust_inflight(tenant, -1)
+                self._adm.notify_all()
+        latency = time.perf_counter() - t0
+        self.stats.record(tenant, "ok", latency,
+                          rows=doc.get("num_rows", 0))
+        doc.update({
+            "pipeline": name,
+            "tenant": tenant,
+            "latency_s": round(latency, 6),
+        })
+        return 200, doc
+
+    def _cache_prefix(self, pipe: Pipeline, args) -> str:
+        digest = hashlib.sha1(repr(tuple(args)).encode()).hexdigest()
+        return os.path.join(self.result_cache_dir,
+                            f"{pipe.name}-{digest[:12]}")
+
+    def _run(self, pipe: Pipeline, args, want_rows: bool,
+             max_rows: int) -> dict:
+        """Evaluate one invocation on the shared Session. Cached
+        pipelines build their slice and run it under the ops/cache.py
+        writethrough tier; plain ones go straight through
+        ``Session.run`` (Func memoization and pragmas intact)."""
+        session = self.session
+        if pipe.cache:
+            from bigslice_tpu.ops.base import Slice
+            from bigslice_tpu.ops.cache import Cache
+
+            slice_ = pipe.fn(*args)
+            if not isinstance(slice_, Slice):
+                raise TypeError(
+                    f"pipeline {pipe.name} returned "
+                    f"{type(slice_).__name__}, expected a Slice"
+                )
+            res = session.run(Cache(slice_,
+                                    self._cache_prefix(pipe, args)))
+        else:
+            res = session.run(pipe.fn, *args)
+        import itertools
+
+        rows: List[list] = []
+        num_rows = 0
+        for f in res.frames():
+            n = len(f)
+            num_rows += n
+            if want_rows and len(rows) < max_rows:
+                take = min(n, max_rows - len(rows))
+                for row in itertools.islice(f.to_host().rows(), take):
+                    rows.append([_jsonable(v) for v in row])
+        res.discard()
+        doc = {"num_rows": num_rows}
+        if want_rows:
+            doc["rows"] = rows
+            doc["rows_truncated"] = num_rows > len(rows)
+        return doc
+
+    # -- shutdown ----------------------------------------------------------
+
+    def close(self, timeout: float = 30.0) -> None:
+        """Graceful shutdown: reject new invocations (503), wake
+        queued waiters so they shed, drain in-flight HTTP handlers
+        (which carry the running invocations), flush a final telemetry
+        snapshot, then release the socket. Idempotent — the session's
+        own shutdown() calls back in here."""
+        with self._adm:
+            if getattr(self, "_closed", False):
+                return
+            self._closed = True
+            self._closing = True
+            self._adm.notify_all()
+        super().close(timeout)
+        self._final_snapshot()
+
+    def _final_snapshot(self, stream=None) -> None:
+        """StatusPrinter-style last word: the serving totals and cache
+        effectiveness an operator wants in the log right before the
+        process exits (never raises — shutdown must finish)."""
+        stream = stream or sys.stderr
+        try:
+            doc = self.serving_stats()
+            tot = doc.get("totals", {})
+            pc = doc.get("program_cache", {})
+            rc = doc.get("result_cache", {})
+            lat = tot.get("latency", {})
+            print(
+                f"sliceserve: shutdown after "
+                f"{tot.get('requests', 0)} requests "
+                f"({tot.get('shed', 0)} shed), "
+                f"{tot.get('rows', 0)} rows; p50 "
+                f"{lat.get('p50_s', 0)}s p99 {lat.get('p99_s', 0)}s; "
+                f"program cache {pc.get('hits', 0)} hits / "
+                f"{pc.get('misses', 0)} misses "
+                f"({pc.get('compile_s_saved', 0)}s compile saved); "
+                f"result cache {rc.get('hit', 0)} hits / "
+                f"{rc.get('miss', 0)} misses",
+                file=stream, flush=True,
+            )
+            hub = getattr(self.session, "telemetry", None)
+            if hub is not None:
+                for line in hub.status_lines():
+                    print(f"sliceserve:{line}", file=stream,
+                          flush=True)
+        except Exception:
+            pass

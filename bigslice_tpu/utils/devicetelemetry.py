@@ -138,23 +138,41 @@ class _InstrumentedProgram:
     the AOT API — permanently falls back to the plain jitted callable
     for this wrapper (correctness never depends on instrumentation).
 
+    When the program carries a cross-session digest (``serve_key`` —
+    the serving plane's content-fingerprinted identity from
+    serve/programcache.py), a local miss additionally probes the
+    process-global program cache before touching XLA: a hit there is a
+    *cross-session* hit (a fresh Session reusing an executable some
+    earlier Session compiled — zero XLA work), and every fresh compile
+    is published back. ``serve_key=None`` (unfingerprintable closures,
+    or the cache disabled) keeps the program session-local, exactly
+    the pre-serving behavior.
+
     Argument-compatibility errors raise *before* execution (donated
     buffers are not yet consumed), so the fallback re-call is safe; a
     genuine runtime failure (OOM, DMA) re-raises unchanged into the
     executor's classification ladder."""
 
     __slots__ = ("_fn", "_rec", "_op", "_inv", "_kind", "_digest",
-                 "_compiled", "_fell_back", "_lock")
+                 "_serve_key", "_compiled", "_cross", "_fell_back",
+                 "_lock")
 
     def __init__(self, fn, recorder: "DeviceTelemetry", op: str,
-                 inv: Optional[int], kind: str, digest: str):
+                 inv: Optional[int], kind: str, digest: str,
+                 serve_key: Optional[str] = None):
         self._fn = fn
         self._rec = recorder
         self._op = op
         self._inv = inv
         self._kind = kind
         self._digest = digest
+        self._serve_key = serve_key
         self._compiled: Dict[tuple, object] = {}
+        # Signatures served from the cross-session cache: a baked-
+        # executable rejection for one of these must also invalidate
+        # the global entry (a poisoned executable must not keep
+        # fanning out to future sessions).
+        self._cross: set = set()
         self._fell_back = False
         # Cached wrapped programs are shared across concurrent group
         # threads; the probe/compile/bookkeeping must not race (two
@@ -189,22 +207,19 @@ class _InstrumentedProgram:
                         # executables, keep running.
                         self._fall_back_locked()
                     else:
-                        t0 = time.perf_counter()
-                        try:
-                            compiled = self._fn.lower(*args).compile()
-                        except Exception:
-                            # No AOT API / lowering quirk: plain jit
-                            # from here on.
-                            self._fall_back_locked()
-                        else:
-                            wall = time.perf_counter() - t0
-                            self._rec.record_compile(
-                                self._op, self._inv, self._kind,
-                                self._digest, wall,
-                                cost=_cost_dict(compiled),
-                                memory=_memory_dict(compiled),
-                            )
+                        compiled = self._serve_probe(sig)
+                        if compiled is not None:
+                            # Cross-session hit: an executable some
+                            # earlier Session compiled — no XLA work
+                            # at all for this program.
                             self._compiled[sig] = compiled
+                            self._cross.add(sig)
+                            self._rec.record_cache_hit(
+                                self._op, self._inv, self._kind,
+                                cross_session=True,
+                            )
+                        else:
+                            compiled = self._compile_locked(sig, args)
                 elif compiled is not None:
                     self._rec.record_cache_hit(self._op, self._inv,
                                                self._kind)
@@ -220,12 +235,75 @@ class _InstrumentedProgram:
                 self._fall_back_locked()
             return self._fn(*args)
 
+    def _serve_probe(self, sig):
+        """Cross-session lookup; never raises (the serving cache is an
+        accelerator, not a dependency)."""
+        if self._serve_key is None:
+            return None
+        try:
+            from bigslice_tpu.serve.programcache import (
+                global_program_cache,
+            )
+
+            return global_program_cache().get(self._serve_key, sig)
+        except Exception:
+            return None
+
+    def _compile_locked(self, sig, args):
+        """AOT-compile under the wrapper lock: record compile wall
+        time + cost/memory, publish to the cross-session cache when
+        the program carries a serve key. Returns the executable, or
+        None after falling back."""
+        t0 = time.perf_counter()
+        try:
+            compiled = self._fn.lower(*args).compile()
+        except Exception:
+            # No AOT API / lowering quirk: plain jit from here on.
+            self._fall_back_locked()
+            return None
+        wall = time.perf_counter() - t0
+        self._rec.record_compile(
+            self._op, self._inv, self._kind, self._digest, wall,
+            cost=_cost_dict(compiled), memory=_memory_dict(compiled),
+        )
+        self._compiled[sig] = compiled
+        if self._serve_key is not None:
+            try:
+                from bigslice_tpu.serve.programcache import (
+                    global_program_cache,
+                )
+
+                global_program_cache().put(self._serve_key, sig,
+                                           compiled, wall)
+            except Exception:
+                pass
+        return compiled
+
     def _fall_back_locked(self) -> None:
         """Permanently route this wrapper to the plain jit, releasing
         every held executable (a fallen-back wrapper must not pin AOT
-        programs the jit path will recompile on its own)."""
+        programs the jit path will recompile on its own). Signatures
+        this wrapper had taken from the cross-session cache are
+        invalidated there too — an executable this process just
+        rejected must not keep fanning out to future sessions."""
         self._fell_back = True
+        if self._serve_key is not None and self._cross:
+            try:
+                from bigslice_tpu.serve.programcache import (
+                    global_program_cache,
+                )
+
+                cache = global_program_cache()
+                for sig in self._cross:
+                    cache.discard(self._serve_key, sig)
+            except Exception:
+                pass
+        self._cross.clear()
         self._compiled.clear()
+        try:
+            self._rec.record_fallback(self._op, self._inv, self._kind)
+        except Exception:
+            pass
 
 
 class _OpDeviceRecord:
@@ -233,6 +311,8 @@ class _OpDeviceRecord:
         self.inv = inv
         self.compiles = 0
         self.cache_hits = 0
+        self.cross_session_hits = 0
+        self.fallbacks = 0
         self.compile_wall_s = 0.0
         self.flops = 0.0
         self.bytes_accessed = 0.0
@@ -291,15 +371,38 @@ class DeviceTelemetry:
     # -- the program seam -------------------------------------------------
 
     def instrument(self, prog, op: str, inv: Optional[int], kind: str,
-                   key_parts) -> _InstrumentedProgram:
+                   key_parts, fns=None,
+                   extra=None) -> _InstrumentedProgram:
         """Wrap a freshly-built jitted program. ``kind`` names the
         program family (``group`` for the op's SPMD program, or the
         auxiliary ``rowslice``/``merge``/``subid_count``/``subid_split``
         /``keyrange`` helpers); ``key_parts`` is the repr-stable
-        partition/shape config the digest derives from."""
+        partition/shape config the digest derives from.
+
+        ``fns`` drives the cross-session program cache
+        (serve/programcache.py): the user functions the program closes
+        over (``()`` for purely structural helpers). ``None`` — the
+        default, so a call site that never audited its closures stays
+        safe — marks the program session-local. ``extra`` is
+        repr-stable serve-key-only material (output schema, lowering-
+        selection bits) the session-local digest deliberately omits."""
+        serve_key = None
+        if fns is not None:
+            try:
+                from bigslice_tpu.serve import programcache as pc
+
+                if pc.cache_capacity() > 0:
+                    fp = pc.fn_fingerprint(fns)
+                    if fp is not None:
+                        serve_key = pc.serve_digest(
+                            op, kind, key_parts, extra, fp
+                        )
+            except Exception:
+                serve_key = None
         return _InstrumentedProgram(
             prog, self, op, inv, kind,
             program_digest(op, kind, key_parts),
+            serve_key=serve_key,
         )
 
     def record_compile(self, op: str, inv: Optional[int], kind: str,
@@ -331,9 +434,28 @@ class DeviceTelemetry:
                    out_bytes=memory.get("output_bytes"))
 
     def record_cache_hit(self, op: str, inv: Optional[int],
-                         kind: str) -> None:
+                         kind: str,
+                         cross_session: bool = False) -> None:
+        """``cross_session=True`` marks a hit served from the process-
+        global program cache (serve/programcache.py) — an executable a
+        *previous* Session compiled. Counted inside ``cache_hits`` (it
+        is a hit) and again in the ``cross_session_hits`` subset (it
+        is the zero-XLA-compile evidence the serving acceptance
+        criterion keys on)."""
         with self._lock:
-            self._op(op, inv).cache_hits += 1
+            rec = self._op(op, inv)
+            rec.cache_hits += 1
+            if cross_session:
+                rec.cross_session_hits += 1
+
+    def record_fallback(self, op: str, inv: Optional[int],
+                        kind: str) -> None:
+        """The wrapper abandoned the AOT path (lowering quirk, baked-
+        executable rejection, signature churn): XLA compiles from here
+        on happen inside plain jit where this recorder cannot see
+        them — the counter that keeps 'compiles == 0' claims honest."""
+        with self._lock:
+            self._op(op, inv).fallbacks += 1
 
     # -- HBM watermarks ---------------------------------------------------
 
@@ -491,7 +613,7 @@ class DeviceTelemetry:
         """The ``telemetry_summary()["device"]`` payload."""
         with self._lock:
             compile_ops = {}
-            tot_compiles = tot_hits = 0
+            tot_compiles = tot_hits = tot_cross = tot_fb = 0
             tot_wall = tot_flops = tot_bytes = 0.0
             donation = {}
             don_expected = don_aliased = 0
@@ -500,11 +622,13 @@ class DeviceTelemetry:
                       "ici_messages": 0, "ici_bytes": 0,
                       "flat_dcn_messages": 0, "flat_dcn_bytes": 0}
             for op, rec in self._ops.items():
-                if rec.compiles or rec.cache_hits:
+                if rec.compiles or rec.cache_hits or rec.fallbacks:
                     compile_ops[op] = {
                         "inv": rec.inv,
                         "compiles": rec.compiles,
                         "cache_hits": rec.cache_hits,
+                        "cross_session_hits": rec.cross_session_hits,
+                        "fallbacks": rec.fallbacks,
                         "compile_s": round(rec.compile_wall_s, 6),
                         "flops": rec.flops,
                         "bytes_accessed": rec.bytes_accessed,
@@ -512,6 +636,8 @@ class DeviceTelemetry:
                     }
                     tot_compiles += rec.compiles
                     tot_hits += rec.cache_hits
+                    tot_cross += rec.cross_session_hits
+                    tot_fb += rec.fallbacks
                     tot_wall += rec.compile_wall_s
                     tot_flops += rec.flops
                     tot_bytes += rec.bytes_accessed
@@ -564,6 +690,8 @@ class DeviceTelemetry:
         totals = {
             "compiles": tot_compiles,
             "cache_hits": tot_hits,
+            "cross_session_hits": tot_cross,
+            "fallbacks": tot_fb,
             "compile_s": round(tot_wall, 6),
             "flops": tot_flops,
             "bytes_accessed": tot_bytes,
@@ -604,9 +732,17 @@ class DeviceTelemetry:
             if rec.compiles:
                 line("bigslice_compile_total",
                      {"op": op, "result": "compile"}, rec.compiles)
-            if rec.cache_hits:
+            local_hits = rec.cache_hits - rec.cross_session_hits
+            if local_hits:
                 line("bigslice_compile_total",
-                     {"op": op, "result": "cache_hit"}, rec.cache_hits)
+                     {"op": op, "result": "cache_hit"}, local_hits)
+            if rec.cross_session_hits:
+                line("bigslice_compile_total",
+                     {"op": op, "result": "cross_session_hit"},
+                     rec.cross_session_hits)
+            if rec.fallbacks:
+                line("bigslice_compile_total",
+                     {"op": op, "result": "fallback"}, rec.fallbacks)
         metric("bigslice_compile_seconds_total",
                "Cumulative XLA compile wall time per op.", "counter")
         for op, rec in ops.items():

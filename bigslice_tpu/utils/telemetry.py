@@ -193,6 +193,11 @@ class TelemetryHub:
         self.device = devicetelemetry.DeviceTelemetry(
             eventer=self._emit
         )
+        # Serving plane (serve/server.py): the invocation server hooks
+        # its per-tenant request/latency/admission stats here so they
+        # ride telemetry_summary()["serving"] and /debug/metrics like
+        # every other signal family. None outside a serving process.
+        self.serving = None
         self.skew_ratio = skew_ratio
         self.skew_min_rows = skew_min_rows
         self.straggler_factor = straggler_factor
@@ -641,6 +646,32 @@ class TelemetryHub:
             out["device"] = self.device.summary()
         except Exception:
             out["device"] = {}
+        # Cross-Session compiled-program cache (serve/programcache.py):
+        # process-scope, so the numbers cover every session this
+        # process ever ran — the serving plane's zero-recompile
+        # evidence. Always present (zeros before any program ran).
+        try:
+            from bigslice_tpu.serve.programcache import (
+                program_cache_stats,
+            )
+
+            out["program_cache"] = program_cache_stats()
+        except Exception:
+            out["program_cache"] = {}
+        # Cross-request result cache (ops/cache.py writethrough tiers):
+        # process-scope hit/miss counts — serving cache effectiveness.
+        try:
+            from bigslice_tpu.ops.cache import result_cache_counts
+
+            out["result_cache"] = result_cache_counts()
+        except Exception:
+            out["result_cache"] = {}
+        serving = self.serving
+        if serving is not None:
+            try:
+                out["serving"] = serving.summary()
+            except Exception:
+                out["serving"] = {}
         return out
 
     @staticmethod
@@ -906,6 +937,59 @@ class TelemetryHub:
             self.device.prometheus_lines(metric, line)
         except Exception:
             pass
+
+        # -- cross-Session program cache (serve/programcache.py) ------
+        try:
+            from bigslice_tpu.serve.programcache import (
+                program_cache_stats,
+            )
+
+            pc = program_cache_stats()
+            metric("bigslice_program_cache_total",
+                   "Cross-Session compiled-program cache outcomes "
+                   "(process scope; serve/programcache.py).",
+                   "counter")
+            for outcome, key in (("hit", "hits"), ("miss", "misses"),
+                                 ("insert", "inserts"),
+                                 ("evict", "evictions"),
+                                 ("discard", "discards")):
+                line("bigslice_program_cache_total",
+                     {"outcome": outcome}, pc.get(key, 0))
+            metric("bigslice_program_cache_entries",
+                   "Compiled executables currently held by the "
+                   "cross-Session program cache.", "gauge")
+            line("bigslice_program_cache_entries", {},
+                 pc.get("entries", 0))
+            metric("bigslice_program_cache_compile_seconds_saved_total",
+                   "XLA compile wall time the cross-Session program "
+                   "cache spared fresh sessions.", "counter")
+            line("bigslice_program_cache_compile_seconds_saved_total",
+                 {}, f"{pc.get('compile_s_saved', 0.0):.6f}")
+        except Exception:
+            pass
+
+        # -- cross-request result cache (ops/cache.py) ----------------
+        try:
+            from bigslice_tpu.ops.cache import result_cache_counts
+
+            rc = result_cache_counts()
+            metric("bigslice_result_cache_total",
+                   "Per-shard result-cache reads by outcome (hit = "
+                   "served from cache, miss = computed + written "
+                   "through; ops/cache.py).", "counter")
+            for outcome, n in sorted(rc.items()):
+                line("bigslice_result_cache_total",
+                     {"outcome": outcome}, n)
+        except Exception:
+            pass
+
+        # -- serving plane (serve/server.py per-tenant stats) ---------
+        serving = self.serving
+        if serving is not None:
+            try:
+                serving.prometheus_lines(metric, line)
+            except Exception:
+                pass
 
         plan = faultinject.active_plan()
         if plan is not None:

@@ -135,11 +135,14 @@ def test_debug_device_endpoint(debug_sess):
     totals = doc["totals"]
     assert totals["compiles"] > 0
     assert totals["compile_s"] > 0
-    # Per-op entries carry per-program cost/memory details.
+    # Per-op entries carry per-program cost/memory details. Pick an
+    # entry that actually compiled here: kind-level shared helpers
+    # (merge/rowslice/subid) may arrive via the cross-Session program
+    # cache with 0 compiles when earlier tests in this process ran
+    # structurally-identical programs.
     ops = doc["compile"]
     assert ops
-    some = next(iter(ops.values()))
-    assert some["compiles"] >= 1
+    some = next(e for e in ops.values() if e["compiles"])
     assert some["programs"] and "compile_s" in some["programs"][0]
     # The waved run sampled per-wave watermarks (CPU → live_arrays).
     assert doc["hbm"]["samples"] > 0

@@ -76,7 +76,11 @@ def test_instrumented_program_falls_back_without_aot_api():
     assert prog(7) == 21
     assert prog._fell_back
     assert len(calls) == 2
-    assert dev.summary()["compile"] == {}  # nothing recorded, no crash
+    # The abandonment itself is recorded (the counter that keeps
+    # 'compiles == 0' serving claims honest); no compiles, no hits.
+    entry = dev.summary()["compile"]["op_c"]
+    assert entry["fallbacks"] == 1
+    assert entry["compiles"] == 0 and entry["cache_hits"] == 0
 
 
 def test_instrumented_donated_program_consumes_buffers():

@@ -1,0 +1,595 @@
+"""Serving plane: cross-Session program cache + invocation server.
+
+The acceptance criteria this file pins:
+
+- a SECOND invocation of the same pipeline on a FRESH Session in the
+  same process performs ZERO XLA compiles (cross-Session program
+  cache, serve/programcache.py — proven through the device-plane
+  hit accounting, not just timing);
+- concurrent multi-tenant load on one shared Session is bit-identical
+  to serial execution of the same invocations;
+- admission control sheds load beyond the configured depth with
+  429/503 instead of queuing unboundedly;
+- result-cache hit/miss and program-cache stats are measurable
+  (telemetry summary + Prometheus);
+- shutdown drains in-flight invocations and flushes a final snapshot.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import bigslice_tpu as bs
+from bigslice_tpu.exec.session import Session
+from bigslice_tpu.serve import programcache as pc_mod
+from bigslice_tpu.serve.server import ServeServer
+
+
+def _add(a, b):
+    return a + b
+
+
+def _mesh_session():
+    import jax
+    from jax.sharding import Mesh
+
+    from bigslice_tpu.exec.meshexec import MeshExecutor
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("shards",))
+    return Session(executor=MeshExecutor(mesh))
+
+
+# ------------------------------------------------- program cache (unit)
+
+def test_fingerprint_content_not_identity():
+    """Two function objects minted from the same code (the
+    fresh-Session case) share a fingerprint; different code or
+    different captured primitives split it."""
+    def mk(k):
+        def f(a, b):
+            return a + b * k
+        return f
+
+    assert pc_mod.fn_fingerprint((mk(3),)) == \
+        pc_mod.fn_fingerprint((mk(3),))
+    assert pc_mod.fn_fingerprint((mk(3),)) != \
+        pc_mod.fn_fingerprint((mk(4),))
+    assert pc_mod.fn_fingerprint(()) == ()
+
+
+def test_fingerprint_hashes_global_values():
+    """Functions with identical bytecode reading DIFFERENT module
+    globals must not share a fingerprint — a served executable traced
+    against a stale global would silently return wrong results."""
+    src = "def f(a, b):\n    return a + b * SCALE\n"
+    ns1: dict = {"SCALE": 2}
+    ns2: dict = {"SCALE": 3}
+    ns3: dict = {"SCALE": 2}
+    exec(src, ns1)
+    exec(src, ns2)
+    exec(src, ns3)
+    f1 = pc_mod.fn_fingerprint((ns1["f"],))
+    assert f1 is not None
+    assert f1 != pc_mod.fn_fingerprint((ns2["f"],))
+    assert f1 == pc_mod.fn_fingerprint((ns3["f"],))
+    # Module references stay fingerprintable (stable by name) —
+    # numpy-using combine fns remain cacheable.
+    nsm: dict = {"np": np}
+    exec("def g(a, b):\n    return np.minimum(a, b)\n", nsm)
+    assert pc_mod.fn_fingerprint((nsm["g"],)) is not None
+    # A mutable-object global bails to session-local.
+    nso: dict = {"STATE": {"k": 1}}
+    exec("def h(a, b):\n    return a + b + STATE['k']\n", nso)
+    assert pc_mod.fn_fingerprint((nso["h"],)) is None
+
+
+def test_fingerprint_bails_on_array_closure():
+    """A closure over an array (content we cannot stably hash) makes
+    the program session-local, never wrongly shared."""
+    def mk(x):
+        def f(a, b):
+            return a + b + x
+        return f
+
+    assert pc_mod.fn_fingerprint((mk(np.arange(3)),)) is None
+
+
+def test_program_cache_lru_and_accounting():
+    c = pc_mod.ProgramCache(capacity=2)
+    c.put("d1", (1,), "exe1", 0.5)
+    c.put("d2", (1,), "exe2", 0.25)
+    assert c.get("d1", (1,)) == "exe1"     # refreshes d1
+    c.put("d3", (1,), "exe3", 0.1)          # evicts d2 (LRU)
+    assert c.get("d2", (1,)) is None
+    s = c.stats()
+    assert s["evictions"] == 1
+    assert s["hits"] == 1 and s["misses"] == 1
+    assert s["compile_s_saved"] == pytest.approx(0.5)
+    assert s["compile_s_evicted"] == pytest.approx(0.25)
+    c.discard("d1", (1,))
+    assert c.get("d1", (1,)) is None
+    assert c.stats()["discards"] == 1
+
+
+def test_program_cache_disabled_is_noop(monkeypatch):
+    monkeypatch.setenv("BIGSLICE_PROGRAM_CACHE", "0")
+    c = pc_mod.ProgramCache()
+    assert not c.enabled
+    c.put("d", (1,), "exe", 1.0)
+    assert c.get("d", (1,)) is None
+    assert len(c) == 0
+
+
+def test_serve_digest_strips_invocation_suffix():
+    d1 = pc_mod.serve_digest("reduce@f.py:10#3", "group", (1,), None,
+                             ())
+    d2 = pc_mod.serve_digest("reduce@f.py:10#7", "group", (1,), None,
+                             ())
+    d3 = pc_mod.serve_digest("reduce@f.py:11", "group", (1,), None,
+                             ())
+    assert d1 == d2 and d1 != d3
+
+
+# ------------------------------------- cross-session zero-compile (e2e)
+
+_XS_DATA = {}
+
+
+def _xs_pipeline():
+    d = _XS_DATA
+    return bs.Reduce(bs.Const(d["shards"], d["keys"], d["vals"]),
+                     _add)
+
+
+def _run_rows(sess, fn):
+    res = sess.run(fn)
+    rows = sorted(map(tuple, res.rows()))
+    res.discard()
+    return rows
+
+
+def test_fresh_session_zero_compiles():
+    """THE serving acceptance criterion: session 2 (fresh, same
+    process) re-runs the pipeline with zero XLA compiles — every
+    program comes back from the cross-Session cache."""
+    rng = np.random.RandomState(42)
+    _XS_DATA.update(
+        shards=8,  # 8 shards on 4 devices → waved (subid machinery)
+        keys=rng.randint(0, 1 << 10, 1 << 14).astype(np.int32),
+        vals=np.ones(1 << 14, np.int32),
+    )
+    s1 = _mesh_session()
+    rows1 = _run_rows(s1, _xs_pipeline)
+    t1 = s1.telemetry_summary()["device"]["totals"]
+    s1.shutdown()
+    assert t1["compiles"] > 0
+
+    pc0 = pc_mod.global_program_cache().stats()
+    s2 = _mesh_session()
+    rows2 = _run_rows(s2, _xs_pipeline)
+    t2 = s2.telemetry_summary()["device"]["totals"]
+    pc1 = pc_mod.global_program_cache().stats()
+    s2.shutdown()
+    assert rows2 == rows1
+    assert t2["fallbacks"] == 0, t2
+    assert t2["compiles"] == 0, t2
+    assert t2["cross_session_hits"] > 0
+    assert pc1["hits"] > pc0["hits"]
+    # Hit accounting also rides the hub summary + Prometheus.
+    assert pc1["compile_s_saved"] > pc0["compile_s_saved"]
+
+
+_OPAQUE_DATA = {}
+
+
+def _opaque_pipeline():
+    d = _OPAQUE_DATA
+    bias = d["bias"]  # np array captured by the combine closure
+
+    def combine(a, b):
+        return a + b + bias[0] - bias[0]
+
+    return bs.Reduce(bs.Const(4, d["keys"], d["vals"]), combine)
+
+
+def test_unfingerprintable_closure_stays_session_local():
+    """A combine fn closing over an array defeats fingerprinting: the
+    program must stay session-local (fresh session recompiles) rather
+    than ever being wrongly shared."""
+    rng = np.random.RandomState(7)
+    _OPAQUE_DATA.update(
+        keys=rng.randint(0, 64, 4096).astype(np.int32),
+        vals=np.ones(4096, np.int32),
+        bias=np.zeros(1, np.int32),
+    )
+    s1 = _mesh_session()
+    rows1 = _run_rows(s1, _opaque_pipeline)
+    s1.shutdown()
+    s2 = _mesh_session()
+    rows2 = _run_rows(s2, _opaque_pipeline)
+    t2 = s2.telemetry_summary()["device"]["totals"]
+    s2.shutdown()
+    assert rows2 == rows1
+    # The group program (opaque closure) recompiled; only structural
+    # helpers may have come from the cache.
+    assert t2["compiles"] > 0
+
+
+# --------------------------------------------------- invocation server
+
+_SRV_DATA = {}
+
+
+def _srv_pipeline(n_keys=64):
+    d = _SRV_DATA
+    return bs.Reduce(bs.Const(4, d["keys"] % np.int32(n_keys),
+                              d["vals"]), _add)
+
+
+@pytest.fixture(scope="module")
+def serve_mesh():
+    """One mesh session + server shared by the HTTP-path tests (module
+    scope: compiles once)."""
+    rng = np.random.RandomState(0)
+    _SRV_DATA.update(
+        keys=rng.randint(0, 1 << 20, 8192).astype(np.int32),
+        vals=np.ones(8192, np.int32),
+    )
+    sess = _mesh_session()
+    srv = ServeServer(sess, port=0, slots=2, queue_depth=8,
+                      tenant_quota=8)
+    srv.register("reduce", _srv_pipeline,
+                 description="keyed reduce (test)")
+    yield srv
+    sess.shutdown()
+
+
+def _post(srv, doc):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}/serve/invoke",
+        data=json.dumps(doc).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=120) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _get(srv, path):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{srv.port}{path}", timeout=30
+    ) as r:
+        return r.status, r.read().decode()
+
+
+def test_invoke_http_roundtrip(serve_mesh):
+    code, doc = _post(serve_mesh, {"pipeline": "reduce",
+                                   "args": [64],
+                                   "tenant": "alice"})
+    assert code == 200, doc
+    assert doc["pipeline"] == "reduce" and doc["tenant"] == "alice"
+    assert doc["num_rows"] == 64
+    assert sum(r[1] for r in doc["rows"]) == 8192
+    assert doc["latency_s"] > 0
+
+
+def test_invoke_unknown_pipeline_404(serve_mesh):
+    code, doc = _post(serve_mesh, {"pipeline": "nope"})
+    assert code == 404
+    assert "reduce" in doc["pipelines"]
+
+
+def test_invoke_bad_args_400(serve_mesh):
+    code, doc = _post(serve_mesh, {"pipeline": "reduce",
+                                   "args": "not-a-list"})
+    assert code == 400
+
+
+def test_invoke_oversized_body_413(serve_mesh):
+    """A Content-Length beyond the body limit answers 413 — not an
+    empty-body parse that misdiagnoses as 'unknown pipeline None'."""
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", serve_mesh.port,
+                                      timeout=30)
+    try:
+        conn.putrequest("POST", "/serve/invoke")
+        conn.putheader("Content-Type", "application/json")
+        conn.putheader("Content-Length", str(17 << 20))
+        conn.endheaders()
+        conn.send(b"{}")  # server must answer without reading 17MB
+        resp = conn.getresponse()
+        assert resp.status == 413
+        assert "too large" in json.loads(resp.read())["error"]
+    finally:
+        conn.close()
+
+
+def test_serve_index_and_healthz(serve_mesh):
+    code, body = _get(serve_mesh, "/serve")
+    assert code == 200 and "/serve/invoke" in body
+    assert "/debug/metrics" in body  # debug surface rides along
+    code, body = _get(serve_mesh, "/healthz")
+    doc = json.loads(body)
+    assert doc["ok"] and "reduce" in doc["pipelines"]
+
+
+def test_serving_stats_and_metrics(serve_mesh):
+    _post(serve_mesh, {"pipeline": "reduce", "args": [64],
+                       "tenant": "bob"})
+    code, body = _get(serve_mesh, "/serve/stats")
+    doc = json.loads(body)
+    assert doc["tenants"]["bob"]["requests"] >= 1
+    assert doc["tenants"]["bob"]["latency"]["p99_s"] > 0
+    assert "program_cache" in doc and "result_cache" in doc
+    assert doc["admission"]["slots"] == 2
+    # The hub carries the serving section + cache families.
+    summary = serve_mesh.session.telemetry_summary()
+    assert summary["serving"]["tenants"]["bob"]["requests"] >= 1
+    assert "hits" in summary["program_cache"]
+    code, body = _get(serve_mesh, "/debug/metrics")
+    assert "bigslice_serving_requests_total" in body
+    assert 'tenant="bob"' in body
+    assert "bigslice_serving_latency_seconds" in body
+    assert "bigslice_program_cache_total" in body
+    assert "bigslice_result_cache_total" in body
+
+
+def test_concurrent_invocations_bit_parity(serve_mesh):
+    """Two threads invoking pipelines on ONE shared Session/executor:
+    results bit-identical to serial execution of the same invocations,
+    and the shared program cache serves the repeats (no recompiles —
+    no interleaving corruption)."""
+    serial = [
+        sorted(map(tuple, _post(serve_mesh,
+                                {"pipeline": "reduce",
+                                 "args": [nk]})[1]["rows"]))
+        for nk in (32, 48) for _ in range(2)
+    ]
+
+    results = {}
+    errs = []
+
+    def worker(i, nk):
+        try:
+            code, doc = _post(serve_mesh, {"pipeline": "reduce",
+                                           "args": [nk],
+                                           "tenant": f"t{i}"})
+            assert code == 200, doc
+            results[i] = sorted(map(tuple, doc["rows"]))
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [
+        threading.Thread(target=worker, args=(i, nk))
+        for i, nk in enumerate([32, 48, 32, 48])
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    concurrent = [results[0], results[2], results[1], results[3]]
+    assert concurrent == serial
+    # The second same-shape invocation hit the program cache.
+    totals = serve_mesh.session.telemetry_summary()["device"]["totals"]
+    assert totals["cache_hits"] > 0
+
+
+# ------------------------------------------------- admission control
+
+@pytest.fixture()
+def slow_server(tmp_path):
+    """Local-tier session + a pipeline whose slice builder blocks on
+    an event — deterministic occupancy for admission tests."""
+    gate = threading.Event()
+    started = threading.Event()
+
+    def slow_pipeline():
+        started.set()
+        gate.wait(30)
+        return bs.Const(1, np.arange(4, dtype=np.int32))
+
+    sess = Session()
+    srv = ServeServer(sess, port=0, slots=1, queue_depth=0,
+                      tenant_quota=1,
+                      result_cache_dir=str(tmp_path))
+    srv.register("slow", slow_pipeline)
+    srv.register("fast",
+                 lambda: bs.Const(1, np.arange(4, dtype=np.int32)))
+    yield srv, gate, started
+    gate.set()
+    sess.shutdown()
+
+
+def test_admission_queue_full_503(slow_server):
+    srv, gate, started = slow_server
+    out = {}
+
+    def occupy():
+        out["first"] = srv.invoke_request({"pipeline": "slow"})
+
+    t = threading.Thread(target=occupy)
+    t.start()
+    assert started.wait(10)
+    # Slot taken, queue_depth=0 → a different tenant sheds with 503.
+    code, doc = srv.invoke_request({"pipeline": "fast",
+                                    "tenant": "other"})
+    assert code == 503 and doc.get("retry")
+    gate.set()
+    t.join(30)
+    assert out["first"][0] == 200
+    stats = srv.stats.summary()
+    assert stats["tenants"]["other"]["outcomes"][
+        "rejected_capacity"] == 1
+    assert stats["totals"]["shed"] >= 1
+
+
+def test_tenant_quota_429(slow_server):
+    srv, gate, started = slow_server
+    srv.queue_depth = 4  # capacity available — quota must trip first
+    out = {}
+
+    def occupy():
+        out["first"] = srv.invoke_request({"pipeline": "slow",
+                                           "tenant": "alice"})
+
+    t = threading.Thread(target=occupy)
+    t.start()
+    assert started.wait(10)
+    code, doc = srv.invoke_request({"pipeline": "fast",
+                                    "tenant": "alice"})
+    assert code == 429 and doc.get("retry")
+    gate.set()
+    t.join(30)
+    assert out["first"][0] == 200
+    outcomes = srv.stats.summary()["tenants"]["alice"]["outcomes"]
+    assert outcomes["rejected_quota"] == 1
+    assert outcomes["ok"] == 1
+
+
+# -------------------------------------------------- result cache
+
+def test_result_cache_hit_accounting(tmp_path):
+    from bigslice_tpu.ops import cache as cache_mod
+
+    sess = Session()
+    srv = ServeServer(sess, port=0, result_cache_dir=str(tmp_path))
+    rng = np.random.RandomState(3)
+    keys = rng.randint(0, 16, 1024).astype(np.int32)
+    vals = np.ones(1024, np.int32)
+
+    def pipeline():
+        return bs.Reduce(bs.Const(2, keys, vals), _add)
+
+    srv.register("cached", pipeline, cache=True)
+    before = cache_mod.result_cache_counts()
+    code, doc1 = srv.invoke_request({"pipeline": "cached"})
+    assert code == 200
+    mid = cache_mod.result_cache_counts()
+    assert mid["miss"] - before["miss"] >= 1  # computed + written
+    code, doc2 = srv.invoke_request({"pipeline": "cached"})
+    assert code == 200
+    after = cache_mod.result_cache_counts()
+    assert after["hit"] - mid["hit"] >= 1  # served from cache files
+    assert sorted(map(tuple, doc2["rows"])) == \
+        sorted(map(tuple, doc1["rows"]))
+    # Prometheus carries the family.
+    text = sess.telemetry.prometheus_text()
+    assert "bigslice_result_cache_total" in text
+    assert 'outcome="hit"' in text
+    sess.shutdown()
+
+
+def test_register_cache_without_dir_raises():
+    sess = Session()
+    srv = ServeServer(sess, port=0)
+    with pytest.raises(ValueError):
+        srv.register("c", lambda: bs.Const(1, np.arange(2)),
+                     cache=True)
+    sess.shutdown()
+
+
+# ---------------------------------------------- graceful shutdown
+
+def test_shutdown_drains_inflight_and_flushes_snapshot():
+    import io
+
+    gate = threading.Event()
+    started = threading.Event()
+
+    def slow_pipeline():
+        started.set()
+        gate.wait(30)
+        return bs.Const(1, np.arange(3, dtype=np.int32))
+
+    sess = Session()
+    srv = ServeServer(sess, port=0, slots=1, queue_depth=2)
+    srv.register("slow", slow_pipeline)
+    out = {}
+
+    def invoke():
+        out["resp"] = _post(srv, {"pipeline": "slow"})
+
+    t = threading.Thread(target=invoke)
+    t.start()
+    assert started.wait(10)
+
+    closer = threading.Thread(target=sess.shutdown)
+    closer.start()
+    time.sleep(0.2)
+    # Mid-drain: new invocations shed, they don't queue.
+    code, doc = srv.invoke_request({"pipeline": "slow"})
+    assert code == 503
+    gate.set()  # let the in-flight invocation finish
+    t.join(30)
+    closer.join(30)
+    # The in-flight invocation COMPLETED during the drain.
+    assert out["resp"][0] == 200, out["resp"]
+    assert out["resp"][1]["num_rows"] == 3
+    # Final snapshot (StatusPrinter-style) flushes on demand too.
+    buf = io.StringIO()
+    srv._final_snapshot(stream=buf)
+    assert "sliceserve: shutdown after" in buf.getvalue()
+
+
+def test_attach_session_swaps_and_rehooks():
+    sess1 = Session()
+    srv = ServeServer(sess1, port=0)
+    srv.register("c", lambda: bs.Const(1, np.arange(2,
+                                                    dtype=np.int32)))
+    assert sess1.serve is srv
+    assert sess1.telemetry.serving is srv.stats
+    sess2 = Session()
+    srv.attach_session(sess2)
+    assert sess2.serve is srv and sess1.serve is None
+    assert sess2.telemetry.serving is srv.stats
+    code, doc = srv.invoke_request({"pipeline": "c"})
+    assert code == 200 and doc["num_rows"] == 2
+    sess1.shutdown()
+    sess2.shutdown()
+
+
+def test_debug_server_close_drains():
+    """DebugServer.close() waits for an in-flight request instead of
+    resetting it (the shutdown-audit satellite)."""
+    from bigslice_tpu.utils.debughttp import DebugServer
+
+    sess = Session()
+    dbg = DebugServer(sess, port=0)
+    release = threading.Event()
+    orig = sess.status.render
+
+    def slow_render():
+        release.wait(10)
+        return orig()
+
+    sess.status.render = slow_render
+    out = {}
+
+    def get_status():
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{dbg.port}/debug/status", timeout=30
+        ) as r:
+            out["code"] = r.status
+
+    t = threading.Thread(target=get_status)
+    t.start()
+    time.sleep(0.2)
+
+    closer = threading.Thread(target=dbg.close)
+    closer.start()
+    time.sleep(0.2)
+    release.set()
+    t.join(10)
+    closer.join(10)
+    assert out.get("code") == 200
+    sess.status.render = orig
+    sess.shutdown()
