@@ -369,6 +369,136 @@ def reduce_wave_2d_bench(keys, vals, num_shards: int, shape=None,
     return len(keys) / best, rows, exchange
 
 
+# ---------------------------------------------------- reduce-wave-spill
+
+def reduce_wave_spill_bench(n_rows: int, iters: int = 3):
+    """The out-of-core shuffle (exec/shuffleplan.py), two phases:
+
+    **A/B (bit-parity ENFORCED)** — the same waved keyed Reduce
+    (S = 4×N shards, non-dense keys) runs interleaved with
+    ``BIGSLICE_SHUFFLE`` unset (today's in-program exchange) and
+    ``=spill`` (every boundary through the store-mediated spill
+    exchange). Raw result rows must match bit-for-bit; the ratio is
+    what spilling costs when you DIDN'T need it.
+
+    **Out-of-core** — S = 32×N shards with the spill budget set to
+    ``corpus_bytes // 4``: the corpus is 4× the aggregate device
+    residency the run is allowed, standing in for a dataset 4× HBM
+    (on CPU meshes the budget is the honest stand-in for the
+    allocator limit; on real TPU the PR-6 measured limit applies).
+    ``BIGSLICE_SHUFFLE=auto`` must choose spill from the estimate,
+    the run must complete, and the recorded per-wave HBM watermark
+    must stay under the budget — all ASSERTED, not just printed.
+
+    Returns a dict the run_mode entry emits."""
+    import gc
+    import os
+
+    import jax
+
+    from bigslice_tpu.exec.meshexec import MeshExecutor
+    from bigslice_tpu.exec.session import Session
+
+    ndev = max(1, len(jax.devices()))
+    rng = np.random.RandomState(42)
+    # ~8x key reduction: low enough that map-side combining cannot
+    # hide the exchange (the out-of-core shape), high enough that the
+    # result stays result-shaped rather than corpus-shaped.
+    keys = rng.randint(0, max(64, n_rows >> 3), n_rows).astype(np.int32)
+    vals = np.ones(n_rows, dtype=np.int32)
+
+    def run(mode, num_shards, budget=None, collect=True):
+        if mode is None:
+            os.environ.pop("BIGSLICE_SHUFFLE", None)
+        else:
+            os.environ["BIGSLICE_SHUFFLE"] = mode
+        if budget is None:
+            os.environ.pop("BIGSLICE_SPILL_BUDGET_BYTES", None)
+        else:
+            os.environ["BIGSLICE_SPILL_BUDGET_BYTES"] = str(budget)
+        sess = None
+        try:
+            sess = Session(executor=MeshExecutor(_mesh()))
+            best, rows = _timed_waved_reduce(sess, keys, vals,
+                                             num_shards, iters,
+                                             collect_rows=collect)
+            summary = sess.telemetry_summary()
+            return len(keys) / best, rows, summary
+        finally:
+            if sess is not None:
+                sess.shutdown()  # failure paths must not leak the
+            os.environ.pop("BIGSLICE_SHUFFLE", None)  # spill temp dir
+            os.environ.pop("BIGSLICE_SPILL_BUDGET_BYTES", None)
+
+    # -- phase 1: interleaved A/B, bit-parity enforced ------------------
+    S_ab = 4 * ndev
+    mem_rps, mem_rows, _ = run(None, S_ab)
+    spill_rps, spill_rows, s_ab = run("spill", S_ab)
+    if spill_rows != mem_rows:
+        raise RuntimeError(
+            "spill result differs from the in-program exchange"
+        )
+    ab_tot = (s_ab.get("device") or {}).get("shuffle_plan", {}).get(
+        "totals", {}
+    )
+    if not ab_tot.get("spill_boundaries"):
+        raise RuntimeError("forced spill plan never engaged")
+    note(f"reduce_wave_spill A/B: in-program {mem_rps:,.0f} rows/s, "
+         f"spill {spill_rps:,.0f} rows/s → "
+         f"{spill_rps / mem_rps:.2f}x, bit-identical "
+         f"({ab_tot['spill_bytes']} spill bytes)")
+
+    # -- phase 2: the >= 4x-budget out-of-core run -----------------------
+    gc.collect()
+    corpus = int(keys.nbytes + vals.nbytes)
+    budget = corpus // 4
+    S_ooc = 32 * ndev
+    ooc_rps, _, s_ooc = run("auto", S_ooc, budget=budget,
+                            collect=False)
+    splan = (s_ooc.get("device") or {}).get("shuffle_plan", {})
+    tot = splan.get("totals", {})
+    if not tot.get("spill_boundaries"):
+        raise RuntimeError(
+            f"auto planner kept the in-program exchange under a "
+            f"{budget}-byte budget ({splan})"
+        )
+    # One op entry per timed invocation (fresh #N-suffixed slices);
+    # they all describe the same boundary — take the largest.
+    entry = max(
+        (e for e in splan["ops"].values() if e["plans"].get("spill")),
+        key=lambda e: e.get("spill_bytes", 0),
+    )
+    if entry["reason"] != "estimate":
+        raise RuntimeError(f"expected estimate-driven spill: {entry}")
+    peak = tot.get("hbm_peak_bytes", 0)
+    if not tot.get("within_budget"):
+        raise RuntimeError(
+            f"per-wave HBM watermark {peak} exceeded the "
+            f"{budget}-byte budget"
+        )
+    note(f"reduce_wave_spill out-of-core: corpus {corpus} B = "
+         f"{corpus / budget:.1f}x the {budget} B budget; "
+         f"{ooc_rps:,.0f} rows/s over {entry['map_waves']} map waves "
+         f"→ {entry['sub_waves']} reduce sub-waves, "
+         f"{entry['spill_bytes']} B spilled across "
+         f"{entry['partitions']} partitions, hbm peak {peak} B "
+         f"(within budget)")
+    return {
+        "inmem_rps": mem_rps,
+        "spill_rps": spill_rps,
+        "ooc_rps": ooc_rps,
+        "corpus_bytes": corpus,
+        "budget_bytes": budget,
+        "hbm_peak_bytes": peak,
+        "within_budget": True,
+        "spill_bytes": entry["spill_bytes"],
+        "partitions": entry["partitions"],
+        "map_waves": entry["map_waves"],
+        "sub_waves": entry["sub_waves"],
+        "est_bytes": entry["est_bytes"],
+    }
+
+
 # ------------------------------------------------------------- staging
 
 def staging_bench(n_rows: int, dim: int = 16, iters: int = 7):
@@ -1327,6 +1457,29 @@ def run_mode(mode: str, size, fallback: bool) -> None:
              flat_dcn_messages=ex["flat_dcn_messages"],
              flat_dcn_bytes=ex["flat_dcn_bytes"],
              dcn_message_reduction=ex.get("dcn_message_reduction"))
+    elif mode == "reduce-wave-spill":
+        # The out-of-core shuffle A/B + beyond-budget run (see
+        # reduce_wave_spill_bench): vs_baseline is the in-program
+        # exchange on the SAME corpus (what forcing spill costs when
+        # in-memory would have fit); the emitted line carries the
+        # 4x-budget out-of-core evidence (plan choice, spill bytes,
+        # wave schedule, hbm-peak-under-budget) the CI smoke asserts.
+        n_rows = size or (1 << 20)
+        r = reduce_wave_spill_bench(n_rows)
+        emit("reduce_wave_spill_e2e_rows_per_sec", r["spill_rps"],
+             "rows/sec", r["inmem_rps"],
+             parity="bit-identical",
+             ooc_rows_per_sec=round(r["ooc_rps"], 3),
+             corpus_bytes=r["corpus_bytes"],
+             budget_bytes=r["budget_bytes"],
+             corpus_vs_budget=round(
+                 r["corpus_bytes"] / r["budget_bytes"], 2),
+             hbm_peak_bytes=r["hbm_peak_bytes"],
+             within_budget=r["within_budget"],
+             spill_bytes=r["spill_bytes"],
+             partitions=r["partitions"],
+             map_waves=r["map_waves"],
+             sub_waves=r["sub_waves"])
     elif mode == "reduce-wave-staged":
         # The serving shape: waved Reduce whose shards stage from
         # encoded stream files (read → decode → assemble → upload is
@@ -1495,6 +1648,7 @@ def main():
     args = sys.argv[1:]
     known = ("reduce", "reduce-sort", "reduce-nohash", "reduce-dense",
              "reduce-wave", "reduce-wave-2d", "reduce-wave-staged",
+             "reduce-wave-spill",
              "staging", "serve-qps",
              "reduce-kernel", "join", "join-dense",
              "join-kernel", "wordcount", "sortshuffle", "cogroup",

@@ -58,8 +58,17 @@ _compiler_serial = _itertools.count(1)
 
 class Compiler:
     def __init__(self, inv_index: int, machine_combiners: bool = False,
-                 mesh_signature=None):
+                 mesh_signature=None, shuffle_mode=None):
         self.inv_index = inv_index
+        # Static shuffle-plan knob (exec/shuffleplan.py), frozen per
+        # compilation: the session resolves BIGSLICE_SHUFFLE once per
+        # run and stamps it on every task, so one invocation's shuffle
+        # boundaries can never straddle a mid-run env flip. "" is the
+        # FROZEN-unset stamp (knob unset at compile time — planner
+        # disengaged for the whole run, even if the env flips later);
+        # None means "no stamping compiler" and lets the executor read
+        # the env itself (ad-hoc compile_slice paths).
+        self.shuffle_mode = shuffle_mode
         # MachineCombiners: share one combiner buffer per process across
         # all producer tasks of a shuffle (exec/session.go:166-176,
         # worker-side two-level combine exec/bigmachine.go:1084-1210).
@@ -214,6 +223,15 @@ class Compiler:
                 bool(part.combiner),
                 bool(part.partition_fn),
                 self.mesh_signature,
+            )
+            # Shuffle-plan stamps (exec/shuffleplan.py): the frozen
+            # static knob, plus the compile-time spill-eligibility
+            # verdict — machine-combined boundaries share one combiner
+            # buffer whose merge re-combines to one-row-per-key, a
+            # contract per-wave spilled partials cannot honor.
+            task.shuffle_mode = self.shuffle_mode
+            task.spill_ineligible = (
+                "machine-combiner buffer" if part.combine_key else None
             )
             # The memo key disambiguates same-op task sets compiled for
             # different partition configs (e.g. Reduce vs Reshuffle

@@ -237,11 +237,20 @@ class LocalExecutor:
 
             return mc_factory
 
+        def lost(t, e):
+            # A Missing tagged spilled_group=True came from a spilled
+            # shuffle partition (every producer shard's rows in one
+            # entry): the WHOLE group re-runs and re-spills, the
+            # machine-combined dep's recovery shape.
+            if getattr(e, "spilled_group", False):
+                return DepLost(t, dep.tasks)
+            return DepLost(t)
+
         def open_one(t):
             try:
                 return self.store.read(t.name, dep.partition)
             except store_mod.Missing as e:
-                raise DepLost(t) from e
+                raise lost(t, e) from e
 
         def factory():
             # expand deps (Reduce consumers) receive per-producer combined,
@@ -259,7 +268,7 @@ class LocalExecutor:
                     try:
                         yield from open_one(t)
                     except store_mod.Missing as e:
-                        raise DepLost(t) from e
+                        raise lost(t, e) from e
 
             return gen()
 

@@ -41,6 +41,7 @@ import numpy as np
 from bigslice_tpu import sliceio
 from bigslice_tpu.frame import codec as codec_mod
 from bigslice_tpu.frame.frame import Frame
+from bigslice_tpu.exec import shuffleplan as shuffleplan_mod
 from bigslice_tpu.exec import staging as staging_mod
 from bigslice_tpu.exec import store as store_mod
 from bigslice_tpu.exec.evaluate import (
@@ -349,6 +350,18 @@ class DeviceGroupOutput:
         with self._views_lock:
             self._wave_views = None
 
+    def release(self) -> None:
+        """Forget device AND host residency (the spill path: this
+        wave's rows now live in the spill store alone, and holding the
+        memoized host chunks would mirror the spilled dataset in
+        RAM)."""
+        self.cols = None
+        self.counts = None
+        with self._chunks_lock:
+            self._chunks = None
+        with self._views_lock:
+            self._wave_views = None
+
 
 class _BridgedStore(store_mod.MemoryStore):
     """The frame store shared with the fallback executor, extended to
@@ -504,6 +517,13 @@ class _DaemonPool:
                 # the next submit), which the bare-thread-per-group
                 # model this pool replaced could never do.
                 traceback.print_exc()
+            finally:
+                # Drop the frame's references BEFORE parking on the
+                # queue: an idle worker holding its last bound
+                # _run_group would otherwise pin a finished (even
+                # shut-down) executor — and every device-resident
+                # output it owns — for up to idle_secs.
+                del fn, args
 
 
 class MeshExecutor:
@@ -638,6 +658,13 @@ class MeshExecutor:
         # thread per process: no concurrent sess.run in this mode.
         self.spmd = spmd
         self.multiprocess = shuffle_mod.is_multiprocess_mesh(mesh)
+        # Out-of-core shuffle spill (exec/shuffleplan.py): the FileStore
+        # the spill exchange writes per-(wave, partition) BSF4 frames
+        # through, created lazily on the first spilled boundary
+        # (BIGSLICE_SPILL_DIR, else a private temp dir removed at
+        # close). With BIGSLICE_SHUFFLE unset nothing here ever runs.
+        self._spill: Optional[store_mod.FileStore] = None
+        self._spill_tmp: Optional[str] = None
         self.store = _BridgedStore(self)
         self.local = LocalExecutor(procs=fallback_procs, store=self.store)
         self._lock = threading.Lock()
@@ -943,9 +970,30 @@ class MeshExecutor:
 
     def close(self) -> None:
         """Session teardown: delete this process's published host-task
-        outputs from the coordination service."""
+        outputs from the coordination service, and remove a private
+        spill temp dir (an operator-named BIGSLICE_SPILL_DIR is theirs
+        to keep)."""
         if self._hostdist is not None:
             self._hostdist.close()
+        if self._spill_tmp is not None:
+            import shutil
+
+            shutil.rmtree(self._spill_tmp, ignore_errors=True)
+            self._spill_tmp = None
+
+    def _spill_store(self) -> store_mod.FileStore:
+        with self._lock:
+            if self._spill is None:
+                import os
+
+                base = os.environ.get("BIGSLICE_SPILL_DIR")
+                if not base:
+                    import tempfile
+
+                    base = tempfile.mkdtemp(prefix="bigslice-spill-")
+                    self._spill_tmp = base
+                self._spill = store_mod.FileStore(base)
+            return self._spill
 
     def submit(self, task: Task) -> None:
         if not self._eligible(task):
@@ -1125,8 +1173,10 @@ class MeshExecutor:
 
     def discard(self, task: Task) -> None:
         with self._lock:
-            self._outputs.pop(task.group_key, None)
+            out = self._outputs.pop(task.group_key, None)
             self._task_index.pop(task.name, None)
+        if isinstance(out, shuffleplan_mod.SpilledGroupOutput):
+            out.discard()  # retire the group's spill-store entries
         self.local.discard(task)
 
     # -- eligibility ------------------------------------------------------
@@ -1533,6 +1583,27 @@ class MeshExecutor:
 
     def _execute_group_inner(self, key, tasks: List[Task]) -> None:
         task0 = tasks[0]
+        N = self.nmesh
+        wave_tasks = [
+            tasks[w * N : (w + 1) * N]
+            for w in range((len(tasks) + N - 1) // N)
+        ]
+        # The shuffle-plan seam (exec/shuffleplan.py): per shuffle
+        # boundary, in-program exchange (default, unchanged) vs the
+        # store-mediated spill exchange. Disengaged — plan None, no
+        # estimate staged, nothing recorded — when BIGSLICE_SHUFFLE is
+        # unset: the chicken-bit contract.
+        plan = inputs0 = None
+        if task0.num_partition > 1 or len(tasks) > self.nmesh:
+            plan, inputs0 = self._shuffle_plan(task0, wave_tasks)
+        if task0.num_partition > 1 and plan is not None:
+            if plan.kind == "spill":
+                out = self._execute_group_spill(task0, wave_tasks,
+                                                plan, inputs0)
+                self._outputs[key] = out
+                self._record_shuffle(task0, out)
+                return
+            self._record_shuffle_plan(task0, plan, None)
         if len(tasks) > self.nmesh:
             # Wave scheduling: stream ceil(S/N) waves of N shards
             # through the device. Partitioned outputs merge on-device
@@ -1540,12 +1611,26 @@ class MeshExecutor:
             # semantics — wave contributions are just multiple
             # producers); unpartitioned outputs keep per-wave shard
             # identity for aligned consumers and the store bridge.
-            N = self.nmesh
-            wave_tasks = [
-                tasks[w * N : (w + 1) * N]
-                for w in range((len(tasks) + N - 1) // N)
-            ]
-            wave_outs = self._execute_waves(task0, wave_tasks)
+            sink = None
+            offloaded: List[DeviceGroupOutput] = []
+            if task0.num_partition <= 1 and plan is not None \
+                    and plan.kind == "spill":
+                # The result plane of the spill plan: a waved
+                # UNPARTITIONED group (the reduce side's own output)
+                # offloads each wave's valid rows to host chunks as it
+                # settles, so the accumulated result never pins device
+                # memory either — without this the consumer's W
+                # capacity-padded wave outputs would dominate the very
+                # watermark the spill exchange exists to bound.
+                # Consumers and result scans already read waved
+                # outputs through host chunks (store bridge).
+                def sink(w: int, wout: DeviceGroupOutput) -> None:
+                    wout.drop_device()
+                    offloaded.append(wout)
+            wave_outs = self._execute_waves(task0, wave_tasks,
+                                            inputs0=inputs0, sink=sink)
+            if sink is not None:
+                wave_outs = offloaded
             if task0.num_partition > 1:
                 merged = self._merge_outputs(wave_outs, task0)
                 self._outputs[key] = merged
@@ -1554,10 +1639,119 @@ class MeshExecutor:
                 self._outputs[key] = WavedGroupOutput(wave_outs,
                                                       self.nmesh)
             return
-        out = self._execute_wave(tasks, wave=0)
+        out = self._execute_wave(tasks, wave=0, inputs=inputs0)
         self._outputs[key] = out
         if task0.num_partition > 1:
             self._record_shuffle(task0, out)
+
+    # -- the shuffle-plan seam (out-of-core spill exchange) --------------
+
+    def _shuffle_plan(self, task0: Task, wave_tasks):
+        """Decide this shuffle boundary's exchange
+        (exec/shuffleplan.py): ``(plan, staged_wave0_inputs)``. The
+        ``auto`` mode stages wave 0 to price the boundary — total
+        staged input bytes (wave-0 bytes × wave count) held against
+        the spill budget (explicit knob, else the PR-6 measured HBM
+        limit) — and the staged inputs are handed forward so wave 0
+        never stages twice. ``(None, None)`` when the knob is unset:
+        the legacy path runs untouched."""
+        mode = getattr(task0, "shuffle_mode", None)
+        if mode is None:  # no stamping compiler: resolve live
+            mode = shuffleplan_mod.plan_mode()
+        if not mode:  # unset (or frozen-unset ""): planner disengaged
+            return None, None
+        ineligible = shuffleplan_mod.spill_ineligible(task0)
+        if ineligible is None and self.multiprocess:
+            # Spill entries are process-local host files; the
+            # cross-process exchange keeps the in-program collectives.
+            ineligible = "multiprocess mesh"
+        est = inputs0 = None
+        budget = shuffleplan_mod.spill_budget_bytes(
+            self._device_telemetry(), self.device_budget_bytes,
+            self.nmesh,
+        )
+        if mode == "auto" and ineligible is None and budget is not None:
+            t0 = time.perf_counter()
+            stats0: dict = {}
+            inputs0 = self._group_inputs(wave_tasks[0], 0, stats=stats0)
+            dur = time.perf_counter() - t0
+            self._telemetry_staging(task0, 0, dur, dur, stats0)
+            wave_bytes = sum(
+                int(getattr(a, "nbytes", 0) or 0)
+                for i in inputs0 for a in list(i[0]) + [i[1]]
+            )
+            est = wave_bytes * len(wave_tasks)
+        plan = shuffleplan_mod.choose(mode, est, budget, ineligible)
+        return plan, inputs0
+
+    def _execute_group_spill(self, task0: Task, wave_tasks,
+                             plan, inputs0):
+        """The out-of-core exchange: each map-side wave runs the
+        EXISTING fused combine+route program (1-D all_to_all or the
+        2-D hierarchical kernels, untouched), then its per-destination
+        partitions are pulled to host, written through the spill store
+        as BSF4 frames (one entry per (wave, partition), fanned out on
+        the staging pool), and the wave's device arrays are released —
+        device residency stays one wave's working set instead of the
+        merged output's, which is what the per-wave HBM watermarks
+        prove out. Consumers read the partitions back through the
+        store bridge in ceil(nparts / nmesh) bounded sub-waves (their
+        own wave loop), re-combining partials per (shard, key) — the
+        same multiple-producer-contributions contract the cross-wave
+        merge relies on, so results are bit-identical to the
+        in-program path (same rows, same wave-major order)."""
+        nparts = task0.num_partition
+        exchange = shuffleplan_mod.SpillExchange(
+            self._spill_store(), task0.name, len(wave_tasks), nparts
+        )
+        schema = task0.schema
+
+        def spill_sink(w: int, wout: DeviceGroupOutput) -> None:
+            chunks = wout.host_chunks()
+            parts = shuffle_mod.partition_chunks(
+                chunks, nparts, wout.nmesh, wout.subid
+            )
+            staging_mod.map_shards(
+                lambda p: exchange.put_partition(w, p, parts[p],
+                                                 schema),
+                range(nparts), self.stage_threads,
+            )
+            wout.release()
+
+        self._execute_waves(task0, wave_tasks, inputs0=inputs0,
+                            sink=spill_sink)
+        out = shuffleplan_mod.SpilledGroupOutput(
+            exchange, schema, nparts, self.nmesh, plan,
+            map_waves=len(wave_tasks),
+        )
+        self._record_shuffle_plan(task0, plan, out)
+        return out
+
+    def _record_shuffle_plan(self, task0: Task, plan, out) -> None:
+        """Per-boundary plan attribution (devicetelemetry): the chosen
+        exchange, the estimate/budget evidence, and — for spilled
+        boundaries — bytes/partitions written and the map-wave /
+        reduce-sub-wave schedule."""
+        dev = self._device_telemetry()
+        if dev is None:
+            return
+        try:
+            kwargs = {}
+            if out is not None:
+                kwargs = dict(
+                    spill_bytes=out.exchange.spill_bytes,
+                    spill_rows=out.exchange.spill_rows,
+                    partitions=out.exchange.partitions_written(),
+                    map_waves=out.map_waves,
+                    sub_waves=out.sub_waves,
+                )
+            dev.record_shuffle_plan(
+                task0.name.op, task0.name.inv_index, plan.kind,
+                plan.reason, est_bytes=plan.est_bytes,
+                budget_bytes=plan.budget_bytes, **kwargs,
+            )
+        except Exception:
+            pass
 
     # -- the overlapped wave pipeline -----------------------------------
 
@@ -1778,6 +1972,19 @@ class MeshExecutor:
         if hub is None or self.multiprocess:
             return
         try:
+            if isinstance(out, shuffleplan_mod.SpilledGroupOutput):
+                # Spilled boundary: the per-partition row totals come
+                # from the exchange manifest (no device counts remain
+                # to sync) — combiner-hidden skew still surfaces.
+                rows = out.exchange.partition_rows()
+                rowbytes = sum(
+                    np.dtype(ct.dtype).itemsize for ct in task0.schema
+                ) or 4
+                hub.record_shuffle(
+                    task0.name.op, task0.name.inv_index, rows,
+                    [r * rowbytes for r in rows],
+                )
+                return
             counts = np.asarray(out.counts).reshape(-1)
             rowbytes = sum(
                 np.dtype(c.dtype).itemsize for c in out.cols
@@ -1808,32 +2015,46 @@ class MeshExecutor:
         return depth
 
     def _execute_waves(self, task0: Task,
-                       wave_tasks: List[List[Task]]
+                       wave_tasks: List[List[Task]],
+                       inputs0=None, sink=None
                        ) -> List[DeviceGroupOutput]:
         """Run a waved group, serially (prefetch_depth 0) or through
         the overlapped pipeline. Wave 0's inputs stage inline either
-        way: the budget-aware depth decision needs their size."""
-        t0 = time.perf_counter()
-        stats0: dict = {}
-        inputs0 = self._group_inputs(wave_tasks[0], 0, stats=stats0)
-        stage0 = time.perf_counter() - t0
-        # Wave 0 staging is exposed by construction (nothing computes
-        # yet for prefetch to hide behind).
-        self._telemetry_staging(task0, 0, stage0, stage0, stats0)
+        way (the budget-aware depth decision needs their size), unless
+        the shuffle planner already staged them for its estimate
+        (``inputs0`` — staging telemetry recorded there). ``sink``,
+        when given, receives each settled wave's output IN WAVE ORDER
+        instead of accumulating it (the spill path streams outputs to
+        the store so device residency never spans waves); the return
+        value is then []."""
+        if inputs0 is None:
+            t0 = time.perf_counter()
+            stats0: dict = {}
+            inputs0 = self._group_inputs(wave_tasks[0], 0, stats=stats0)
+            stage0 = time.perf_counter() - t0
+            # Wave 0 staging is exposed by construction (nothing
+            # computes yet for prefetch to hide behind).
+            self._telemetry_staging(task0, 0, stage0, stage0, stats0)
         depth = self._effective_prefetch_depth(task0, inputs0,
                                                len(wave_tasks))
         if depth == 0:
-            outs = [self._execute_wave(wave_tasks[0], 0,
-                                       inputs=inputs0)]
-            for w in range(1, len(wave_tasks)):
-                outs.append(self._execute_wave(wave_tasks[w], wave=w))
+            outs: List[DeviceGroupOutput] = []
+            for w in range(len(wave_tasks)):
+                ow = self._execute_wave(
+                    wave_tasks[w], wave=w,
+                    inputs=inputs0 if w == 0 else None,
+                )
+                if sink is not None:
+                    sink(w, ow)
+                else:
+                    outs.append(ow)
             return outs
         return self._execute_waves_pipelined(task0, wave_tasks,
-                                             inputs0, depth)
+                                             inputs0, depth, sink=sink)
 
     def _execute_waves_pipelined(self, task0: Task,
                                  wave_tasks: List[List[Task]],
-                                 inputs0, depth: int
+                                 inputs0, depth: int, sink=None
                                  ) -> List[DeviceGroupOutput]:
         """The pipelined loop: a prefetcher thread stages wave w+1's
         inputs (store reads, host concat, device_put) while wave w
@@ -1903,7 +2124,17 @@ class MeshExecutor:
         inflight: "deque" = deque()
         def settle_one():
             entry, wv, t_disp = inflight.popleft()
-            outs.append(self._settle_wave(entry))
+            return wv, self._settle_wave(entry), t_disp
+
+        def deliver(wv, out, t_disp):
+            # OUTSIDE the wave mutex: the sink (spill readback + store
+            # write) is host work that must not hold the collective
+            # slot against concurrent evaluations or this pipeline's
+            # own next dispatch.
+            if sink is not None:
+                sink(wv, out)
+            else:
+                outs.append(out)
             # Dispatch→settle wall time: with in-flight overlap this
             # over-counts queue time per wave, but the SUM is the true
             # device-busy window the staging overlap hides behind.
@@ -1936,6 +2167,7 @@ class MeshExecutor:
                 # already serializes program execution, so holding the
                 # slot across the in-flight window isn't needed — the
                 # mutex only makes each dispatch/settle step atomic.
+                settled = []
                 with self._wave_mutex:
                     inflight.append(
                         (self._dispatch_wave(wave_tasks[w], w,
@@ -1943,10 +2175,13 @@ class MeshExecutor:
                          time.perf_counter())
                     )
                     while len(inflight) > window:
-                        settle_one()
+                        settled.append(settle_one())
+                for s in settled:
+                    deliver(*s)
             while inflight:
                 with self._wave_mutex:
-                    settle_one()
+                    s = settle_one()
+                deliver(*s)
             return outs
         finally:
             stop.set()
@@ -1963,13 +2198,32 @@ class MeshExecutor:
         bounded host cache off-thread so the staging read doesn't
         stall on disk/GCS latency; memory tiers no-op. Deps with
         device-resident outputs never need it (they chain zero-copy
-        or re-upload from RAM)."""
+        or re-upload from RAM) — EXCEPT spilled shuffle boundaries,
+        whose partitions live in the spill FileStore: sub-wave N+1's
+        partitions warm while sub-wave N computes (the same PR-1
+        machinery, chicken-bitted by prefetch_depth like every other
+        hint)."""
         for wt in wave_tasks[lo:hi]:
             for t in wt:
                 for dep in t.deps:
                     for p in dep.tasks:
-                        if not self._has_device_output(p.name):
+                        spilled = self._spilled_output_for(p.name)
+                        if spilled is not None:
+                            if p.name.shard == 0:
+                                spilled.exchange.prefetch(dep.partition)
+                        elif not self._has_device_output(p.name):
                             self.store.prefetch(p.name, dep.partition)
+
+    def _spilled_output_for(self, name: TaskName):
+        """The SpilledGroupOutput serving ``name``'s group, or None."""
+        with self._lock:
+            entry = self._task_index.get(name)
+            if entry is None:
+                return None
+            out = self._outputs.get(entry[0])
+        if isinstance(out, shuffleplan_mod.SpilledGroupOutput):
+            return out
+        return None
 
     def _dispatch_wave(self, tasks: List[Task], wave: int, inputs):
         """Non-blocking wave launch for the pipeline: auto-dense probe
@@ -2806,6 +3060,12 @@ class MeshExecutor:
                             self.store.read(p.name, dep.partition)
                         )
                     except store_mod.Missing as e:
+                        if getattr(e, "spilled_group", False):
+                            # A lost SPILLED partition holds every
+                            # producer shard's rows: the whole group
+                            # must re-run (and re-spill) — the
+                            # machine-combined dep's recovery shape.
+                            raise DepLost(p, dep.tasks) from e
                         raise DepLost(p) from e
             return frames, ck.seconds
 
@@ -3953,6 +4213,21 @@ class MeshExecutor:
             out = self._outputs.get(key)
         if out is None:
             return None
+        if isinstance(out, shuffleplan_mod.SpilledGroupOutput):
+            # Spilled shuffle boundary: partitions live in the spill
+            # store, attributed (like every merged partitioned output)
+            # to producer shard 0. Loss surfaces as Missing tagged
+            # spilled_group=True: recovery must re-run the WHOLE
+            # producer group — a spilled partition holds every shard's
+            # contribution, so a single-shard recompute could never
+            # rebuild it.
+            if task.name.shard != 0 or partition >= out.nparts:
+                return []
+            try:
+                return out.frames_for(partition) or []
+            except store_mod.Missing as e:
+                e.spilled_group = True
+                raise
 
         def frame_for(cols):
             from bigslice_tpu.ops.cogroup import Cogroup
@@ -3991,18 +4266,14 @@ class MeshExecutor:
             # consumers.
             if shard != 0:
                 return []
-            if out.subid:
-                # Wave-partitioned: device p % nmesh holds partition p
-                # where the leading subid column == p // nmesh — the
-                # PRODUCING mesh's size (resize may have changed the
-                # executor's since).
-                dev = partition % out.nmesh
-                sub = partition // out.nmesh
-                dev_cols = [c[dev] for c in chunks]
-                sel = np.asarray(dev_cols[0]) == sub
-                cols = [np.asarray(c)[sel] for c in dev_cols[1:]]
-            else:
-                cols = [c[partition] for c in chunks]
+            # Partition addressing (device p % nmesh; subid selects
+            # p // nmesh on wave-partitioned outputs) via THE shared
+            # host-side contract (shuffle.partition_cols — the spill
+            # exchange's map-side split uses the same fn), against the
+            # PRODUCING mesh's size (resize may have changed the
+            # executor's since).
+            cols = shuffle_mod.partition_cols(chunks, partition,
+                                              out.nmesh, out.subid)
         else:
             if partition != 0:
                 return []

@@ -418,9 +418,12 @@ class Session:
                 location=f"{loc[0]}:{loc[1]}" if loc else "?",
                 args=", ".join(reprlib.repr(a) for a in args),
             )
+        from bigslice_tpu.exec import shuffleplan as shuffleplan_mod
+
         tasks = compile_mod.Compiler(
             inv_index, machine_combiners=self.machine_combiners,
             mesh_signature=self._mesh_signature(),
+            shuffle_mode=shuffleplan_mod.plan_mode() or "",
         ).compile(slice_)
         if self.debug is not None:
             self.debug.register_roots(tasks)

@@ -1,0 +1,325 @@
+"""The shuffle-plan seam: in-program exchange vs store-mediated spill.
+
+Every shuffle boundary used to be exactly one in-program exchange: the
+wave programs route + combine on device, and the cross-wave merge holds
+the WHOLE partitioned output resident in device memory until consumers
+read it — so a keyed reduce's working set had to fit aggregate HBM no
+matter how many waves the input side streamed in (the wave splitter only
+tiles inputs; PR-6's per-wave HBM watermarks show exactly when a shape
+will OOM). Exoshuffle's argument (PAPERS.md) is that shuffle belongs
+behind a pluggable, application-level seam; the portable-collectives
+paper's is that one oversized exchange should decompose into a schedule
+of bounded-footprint rounds. This module is both, made concrete for the
+mesh executor:
+
+- ``ShufflePlan`` — the per-boundary decision record: ``in_program``
+  (today's all_to_all / hierarchical kernels, unchanged and the
+  default) or ``spill`` (the out-of-core path below), with the
+  estimate/budget evidence that drove the choice.
+- The **planner** (``choose``): a static ``BIGSLICE_SHUFFLE`` knob
+  (unset/``in_program`` = bit-identical legacy path; ``spill`` = force
+  the spill exchange on every eligible boundary; ``auto`` = spill when
+  the staged-input-bytes estimate for the boundary exceeds the spill
+  budget — ``BIGSLICE_SPILL_BUDGET_BYTES``, else the PR-6 measured HBM
+  limit, else the aggregate ``device_budget_bytes``).
+- ``SpillExchange`` — the store-mediated exchange: each map-side wave
+  still runs the existing fused combine+route program (1-D all_to_all
+  or the 2-D hierarchical kernels, untouched), but its per-destination
+  partitions are pulled to host and written through ``exec/store.py``
+  as BSF4 frames, one store entry per (wave, partition), and the
+  device arrays are dropped before the next wave dispatches — device
+  residency is ONE wave's working set, never the merged output.
+  Reduce-side consumer waves stream the partitions back in over
+  ceil(nparts / nmesh) bounded sub-waves (the consumer's own wave
+  loop), re-combining partials per (shard, key) in their combine
+  stage — the same multiple-producer-contributions contract the
+  cross-wave merge already relied on, so results are bit-identical to
+  the single-exchange path (same rows, same wave-major order).
+
+Fault tolerance is by construction, not new machinery: the spill store
+is a ``FileStore``, so corruption quarantines (codec checksums →
+``*.quarantine`` → ``Missing``), loss surfaces as ``Missing`` →
+``DepLost`` → producer-group recompute (which rewrites every spill
+entry), and the chaos plane covers the new seams (``spill.write``
+transient at the write entry, ``spill.read`` loss at read-back).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from bigslice_tpu.frame.frame import Frame
+from bigslice_tpu.exec import store as store_mod
+from bigslice_tpu.exec.task import TaskName
+from bigslice_tpu.utils import faultinject, fileio
+
+#: Recognized BIGSLICE_SHUFFLE values. Unset behaves as "in_program"
+#: with the planner fully disengaged (no estimate, no telemetry) — the
+#: chicken-bit contract: today's exchange, bit-identically.
+MODES = ("in_program", "spill", "auto")
+
+
+def plan_mode(env: Optional[str] = None) -> Optional[str]:
+    """The static knob: None (unset — planner disengaged), or one of
+    MODES. Unknown values fail loudly — a typo'd ``BIGSLICE_SHUFFLE=``
+    silently running the wrong exchange would be a debugging pit."""
+    if env is None:
+        env = os.environ.get("BIGSLICE_SHUFFLE", "")
+    env = env.strip()
+    if not env:
+        return None
+    if env not in MODES:
+        raise ValueError(
+            f"BIGSLICE_SHUFFLE must be one of {MODES}, got {env!r}"
+        )
+    return env
+
+
+def spill_budget_bytes(device_telemetry=None,
+                       device_budget_bytes: Optional[int] = None,
+                       nmesh: int = 1) -> Optional[int]:
+    """The aggregate device-memory budget the ``auto`` planner holds a
+    boundary's staged bytes against: the explicit
+    ``BIGSLICE_SPILL_BUDGET_BYTES`` knob first, else the PR-6 measured
+    HBM limit (the backend allocator's ``bytes_limit`` the watermark
+    sampler recorded), else the executor's per-device working-set
+    budget × mesh size. None = no budget known — auto stays
+    in-program."""
+    env = os.environ.get("BIGSLICE_SPILL_BUDGET_BYTES")
+    if env:
+        return int(env)
+    if device_telemetry is not None:
+        measured = device_telemetry.hbm_budget()
+        if measured:
+            return int(measured)
+    if device_budget_bytes:
+        return int(device_budget_bytes) * max(1, int(nmesh))
+    return None
+
+
+class ShufflePlan(NamedTuple):
+    """One shuffle boundary's exchange decision + evidence."""
+
+    kind: str                    # "in_program" | "spill"
+    reason: str                  # "forced" | "estimate" | "default" | ...
+    est_bytes: Optional[int]     # staged-input-bytes estimate (auto)
+    budget_bytes: Optional[int]  # the budget the estimate was held to
+
+
+def choose(mode: Optional[str], est_bytes: Optional[int],
+           budget_bytes: Optional[int],
+           ineligible: Optional[str] = None) -> Optional[ShufflePlan]:
+    """The planner. ``mode`` is the static knob (None = disengaged →
+    returns None, the caller runs the legacy path untouched);
+    ``ineligible`` names why this boundary cannot spill (multiprocess
+    mesh, machine-combiner buffer) — a forced/auto spill then records
+    an in-program plan carrying the reason instead of silently
+    diverging."""
+    if mode is None:
+        return None
+    if mode == "in_program":
+        return ShufflePlan("in_program", "forced", est_bytes,
+                           budget_bytes)
+    if ineligible:
+        return ShufflePlan("in_program", f"ineligible: {ineligible}",
+                           est_bytes, budget_bytes)
+    if mode == "spill":
+        return ShufflePlan("spill", "forced", est_bytes, budget_bytes)
+    # auto: spill only when the boundary's staged bytes provably exceed
+    # the budget; no budget or no estimate keeps the in-program path
+    # (the conservative default — spilling costs host round-trips).
+    if (est_bytes is not None and budget_bytes is not None
+            and est_bytes > budget_bytes):
+        return ShufflePlan("spill", "estimate", est_bytes, budget_bytes)
+    return ShufflePlan(
+        "in_program",
+        "estimate" if (est_bytes is not None
+                       and budget_bytes is not None) else "no-budget",
+        est_bytes, budget_bytes,
+    )
+
+
+def spill_ineligible(task) -> Optional[str]:
+    """Why a shuffle-boundary task can never take the spill path, or
+    None. Machine-combined (combine_key) groups are excluded: the
+    cross-wave merge RE-COMBINES their partials so every consumer sees
+    at most one row per key (the shared per-machine buffer contract) —
+    spilled per-wave partials would break that invariant for consumers
+    that don't re-combine. The compiler stamps the same verdict at
+    compile time (``task.spill_ineligible``)."""
+    stamped = getattr(task, "spill_ineligible", None)
+    if stamped:
+        return stamped
+    if task.partitioner.combine_key:
+        return "machine-combiner buffer"
+    return None
+
+
+# -- the store-mediated exchange ------------------------------------------
+
+
+class SpillExchange:
+    """Per-(map wave, partition) spill entries for ONE shuffle-boundary
+    group, written through a ``FileStore`` (BSF4 frames, checksummed,
+    quarantine-on-corruption) and read back partition-at-a-time by the
+    reduce side. Entry names are deterministic
+    (``{op}~spill`` / shard=wave), so a recomputed group overwrites its
+    own entries in place. The manifest records which (wave, partition)
+    entries hold rows — empty partitions are never written, and a read
+    that misses a MANIFESTED entry is a genuine loss (``Missing`` →
+    ``DepLost`` → recompute), never an ambiguous absence."""
+
+    def __init__(self, store: store_mod.Store, name: TaskName,
+                 nwaves: int, nparts: int):
+        self.store = store
+        self.nparts = int(nparts)
+        self.nwaves = int(nwaves)
+        self.names = [
+            TaskName(name.inv_index, f"{name.op}~spill", w, nwaves)
+            for w in range(nwaves)
+        ]
+        self._lock = threading.Lock()
+        # (wave, partition) -> (rows, bytes). Written while the group
+        # runs (before its tasks turn OK), read-only afterwards.
+        self._manifest: Dict[Tuple[int, int], Tuple[int, int]] = {}
+        self.spill_bytes = 0
+        self.spill_rows = 0
+
+    def put_partition(self, wave: int, partition: int,
+                      cols: List[np.ndarray], schema) -> int:
+        """Write one partition's rows for one map wave (skipping empty
+        partitions). The chaos seam fires at ENTRY, before any frame
+        is built: an injected transient is retried like any flaky
+        write (``fileio`` bounded backoff), and the underlying
+        ``FileStore.put`` keeps its own ``store.put`` seam + atomic
+        commit."""
+        rows = int(len(cols[0])) if cols else 0
+        if rows == 0:
+            return 0
+        if faultinject.ENABLED:
+            fileio.retry_transient(
+                lambda: faultinject.maybe_raise("spill.write"),
+                "spill.write",
+            )
+        frame = Frame(list(cols), schema)
+        self.store.put(self.names[wave], partition, [frame])
+        nbytes = sum(
+            int(getattr(c, "nbytes", 0) or 0) for c in cols
+        )
+        with self._lock:
+            self._manifest[(wave, partition)] = (rows, nbytes)
+            self.spill_bytes += nbytes
+            self.spill_rows += rows
+        return nbytes
+
+    def partition_rows(self) -> List[int]:
+        """Per-partition row totals across waves (skew telemetry)."""
+        out = [0] * self.nparts
+        with self._lock:
+            for (_, p), (rows, _) in self._manifest.items():
+                out[p] += rows
+        return out
+
+    def partitions_written(self) -> int:
+        with self._lock:
+            return len({p for (_, p) in self._manifest})
+
+    def read_partition(self, partition: int) -> List[Frame]:
+        """All of a partition's spilled frames, in map-wave order —
+        the same wave-major row order the in-program cross-wave merge
+        produces, which is what keeps the reduce-side re-combine
+        bit-identical. Loss (injected or real) raises ``Missing``; the
+        store bridge converts that to ``DepLost`` and the producer
+        group recomputes (rewriting every entry)."""
+        with self._lock:
+            waves = [w for w in range(self.nwaves)
+                     if (w, partition) in self._manifest]
+        frames: List[Frame] = []
+        for w in waves:
+            name = self.names[w]
+            if faultinject.ENABLED:
+                f = faultinject.fire("spill.read")
+                if f is not None:
+                    # The spilled partition vanishes, as if the disk
+                    # holding it died between map and reduce.
+                    drop = getattr(self.store, "drop", None)
+                    if drop is not None:
+                        drop(name, partition)
+                    e = store_mod.Missing(
+                        f"{name} p{partition} (injected spill loss)"
+                    )
+                    e.fault = f
+                    e.fault_site = f.site
+                    raise e
+            frames.extend(self.store.read(name, partition))
+        return frames
+
+    def prefetch(self, partition: int) -> None:
+        """Advisory read-ahead: warm every wave's entry for this
+        partition (the reduce-side prefetcher hints sub-wave N+1's
+        partitions while sub-wave N computes; FileStore's bounded warm
+        cache + single drain worker do the rest)."""
+        with self._lock:
+            waves = [w for w in range(self.nwaves)
+                     if (w, partition) in self._manifest]
+        for w in waves:
+            self.store.prefetch(self.names[w], partition)
+
+    def discard(self) -> None:
+        """Drop every spill entry (group output discarded/superseded)."""
+        for name in self.names:
+            try:
+                self.store.discard(name)
+            except Exception:  # noqa: BLE001 — best-effort hygiene
+                pass
+        with self._lock:
+            self._manifest.clear()
+
+
+class SpilledGroupOutput:
+    """A shuffle-boundary group's output living in the spill store
+    instead of device memory. Mesh-resident consumers read it through
+    the store bridge exactly like a fallback-produced dep (partition p
+    attributed to producer shard 0 — the merged-output contract), so
+    no consumer-side program changes exist; device arrays were dropped
+    wave by wave as the map side spilled. Survives mesh resize by
+    construction (nothing device-resident to salvage or lose)."""
+
+    partitioned = True
+    subid = False
+    waves = None       # not a WavedGroupOutput
+    cols = None        # no device residency: _dep_input re-reads via
+    counts = None      # the store bridge, never zero-copy chains
+    gathered = True    # host-readable without a collective
+
+    def __init__(self, exchange: SpillExchange, schema, nparts: int,
+                 nmesh: int, plan: ShufflePlan, map_waves: int):
+        self.exchange = exchange
+        self.schema = schema
+        self.nparts = int(nparts)
+        self.nmesh = int(nmesh)
+        self.plan = plan
+        self.map_waves = int(map_waves)
+
+    @property
+    def sub_waves(self) -> int:
+        """Reduce-side read-back rounds: consumers stream the nparts
+        partitions through the mesh in ceil(nparts / nmesh) bounded
+        sub-waves (their own wave loop)."""
+        return (self.nparts + self.nmesh - 1) // self.nmesh
+
+    def gather(self) -> None:  # pragma: no cover - single-process only
+        return None
+
+    def drop_device(self) -> None:
+        return None  # nothing device-resident; spill entries persist
+
+    def frames_for(self, partition: int) -> List[Frame]:
+        return self.exchange.read_partition(partition)
+
+    def discard(self) -> None:
+        self.exchange.discard()

@@ -68,6 +68,13 @@ class Store:
         disk/GCS latency."""
         return None
 
+    def drop(self, name: TaskName, partition: int) -> None:
+        """Remove ONE partition entry (finer-grained than discard).
+        Best-effort; the default is a no-op. Used by the chaos plane's
+        spill-loss injection and by callers retiring single spilled
+        partitions."""
+        return None
+
     def discard(self, name: TaskName) -> None:
         raise NotImplementedError
 
@@ -103,6 +110,10 @@ class MemoryStore(Store):
             raise Missing(f"{name} p{partition}")
         return iter(list(frames))
 
+    def drop(self, name, partition):
+        with self._lock:
+            self._data.pop((name, partition), None)
+
     def discard(self, name):
         with self._lock:
             for key in [k for k in self._data if k[0] == name]:
@@ -122,7 +133,15 @@ class FileStore(Store):
     # host memory (FIFO) — read-ahead for a handful of upcoming waves,
     # never an unbounded mirror of the spilled dataset. The pending
     # queue shares the bound: hints beyond it drop (advisory contract).
-    PREFETCH_CACHE_MAX = 32
+    # Tunable (BIGSLICE_PREFETCH_CACHE, read lazily like every other
+    # BIGSLICE_* knob so runtime/monkeypatched settings take): the
+    # out-of-core spill exchange hints one entry per (map wave,
+    # partition), so deep map-wave counts on wide meshes can want more
+    # than the default's headroom.
+    @property
+    def PREFETCH_CACHE_MAX(self) -> int:
+        env = os.environ.get("BIGSLICE_PREFETCH_CACHE")
+        return int(env) if env else 32
 
     def __init__(self, prefix: str):
         self.prefix = prefix
@@ -291,6 +310,14 @@ class FileStore(Store):
             fileio.rename(path, path + ".quarantine")
         except Exception:  # noqa: BLE001 — removal is the fallback
             fileio.remove(path)
+
+    def drop(self, name, partition):
+        """Remove one partition file (+ its warmed frames): the spill
+        chaos plane's loss injection, and single-partition retirement."""
+        with self._warm_lock:
+            self._warm_gen[name] = self._warm_gen.get(name, 0) + 1
+            self._warm.pop((name, partition), None)
+        fileio.remove(self._path(name, partition))
 
     def discard(self, name):
         with self._warm_lock:  # never serve a discarded task's frames

@@ -15,7 +15,10 @@ via utils/fileio — the reference's S3-capable cache contract.
 
 from __future__ import annotations
 
+import os
 import threading
+import time
+from collections import OrderedDict
 from typing import Optional
 
 from bigslice_tpu import typecheck
@@ -58,6 +61,146 @@ def reset_result_cache_counts() -> None:
             _rc_counts[k] = 0
 
 
+# -- TTL + byte-bounded LRU eviction ---------------------------------------
+#
+# Entries used to live forever: a resident server (serve/server.py)
+# caching per-(pipeline, args) results accumulated shard files without
+# bound, and a stale entry served stale data for the process's
+# lifetime. Two independent, both-optional policies now bound the tier:
+#
+# - **TTL** (``BIGSLICE_RESULT_CACHE_TTL_S`` / ``ttl_s``): a shard file
+#   older than the TTL is an *expired* MISS — removed at presence-scan
+#   time, recomputed, written through fresh (counter outcome
+#   ``expired``).
+# - **Byte-bounded LRU** (``BIGSLICE_RESULT_CACHE_MAX_BYTES`` /
+#   ``max_bytes``): a process-scope registry tracks shard files by
+#   last use (construction scan, read, writethrough all refresh); when
+#   tracked bytes exceed the bound, least-recently-used files are
+#   deleted (counter outcome ``evicted``). The most recent entry
+#   always survives — evicting what was just written would make every
+#   write useless.
+#
+# A read racing an eviction is safe: the open fd keeps streaming on
+# POSIX, and a presence-map hit whose file vanished falls back to
+# recompute + writethrough (``_CachedSlice._read_or_recompute``)
+# instead of crashing the task.
+
+_rc_policy = {"ttl_s": None, "max_bytes": None}
+_rc_env_loaded = False
+# path -> bytes, in LRU order (first = coldest); _rc_total_bytes is
+# the maintained running sum so the byte-bound check is O(1) under
+# the lock.
+_rc_registry: "OrderedDict[str, int]" = OrderedDict()
+_rc_total_bytes = 0
+
+
+def _load_policy_env_locked() -> None:
+    global _rc_env_loaded
+    if _rc_env_loaded:
+        return
+    _rc_env_loaded = True
+    ttl = os.environ.get("BIGSLICE_RESULT_CACHE_TTL_S")
+    if ttl:
+        _rc_policy["ttl_s"] = float(ttl)
+    mb = os.environ.get("BIGSLICE_RESULT_CACHE_MAX_BYTES")
+    if mb:
+        _rc_policy["max_bytes"] = int(mb)
+
+
+def configure_result_cache(ttl_s=..., max_bytes=...) -> None:
+    """Set the eviction policy programmatically (the serving plane's
+    constructor knobs). ``None`` disables a policy; omitted arguments
+    keep the current (env-seeded) value."""
+    with _rc_lock:
+        _load_policy_env_locked()
+        if ttl_s is not ...:
+            _rc_policy["ttl_s"] = float(ttl_s) if ttl_s else None
+        if max_bytes is not ...:
+            _rc_policy["max_bytes"] = (int(max_bytes) if max_bytes
+                                       else None)
+
+
+def result_cache_policy() -> dict:
+    """The active policy + registry footprint (stats surfaces)."""
+    with _rc_lock:
+        _load_policy_env_locked()
+        return {
+            "ttl_s": _rc_policy["ttl_s"],
+            "max_bytes": _rc_policy["max_bytes"],
+            "tracked_files": len(_rc_registry),
+            "tracked_bytes": _rc_total_bytes,
+        }
+
+
+def reset_result_cache_policy() -> None:
+    """Forget policy + registry and re-read the env next use (tests)."""
+    global _rc_env_loaded, _rc_total_bytes
+    with _rc_lock:
+        _rc_env_loaded = False
+        _rc_policy["ttl_s"] = None
+        _rc_policy["max_bytes"] = None
+        _rc_registry.clear()
+        _rc_total_bytes = 0
+
+
+def _expired(path: str) -> bool:
+    """TTL check for one shard file; an expired file is removed and
+    counted so the presence scan treats it as a miss."""
+    with _rc_lock:
+        _load_policy_env_locked()
+        ttl = _rc_policy["ttl_s"]
+    if not ttl:
+        return False
+    m = fileio.mtime(path)
+    if m is None or time.time() - m <= ttl:
+        return False
+    fileio.remove(path)
+    global _rc_total_bytes
+    with _rc_lock:
+        _rc_counts["expired"] = _rc_counts.get("expired", 0) + 1
+        known = _rc_registry.pop(path, None)
+        if known is not None:
+            _rc_total_bytes -= known
+    return True
+
+
+def _touch(path: str, nbytes: Optional[int] = None) -> None:
+    """Refresh ``path``'s LRU position (registering it when new),
+    then enforce the byte bound. The file stat for an unknown size
+    runs OUTSIDE the lock (on object stores it is a network
+    roundtrip), and the bound check is O(1) against the maintained
+    running total — concurrent cache reads never queue behind
+    lock-held IO."""
+    global _rc_total_bytes
+    with _rc_lock:
+        _load_policy_env_locked()
+        if _rc_policy["max_bytes"] is None:
+            return
+        known = _rc_registry.get(path)
+    if nbytes is None:
+        nbytes = known
+    if nbytes is None:
+        nbytes = fileio.size(path) or 0
+    evict = []
+    with _rc_lock:
+        if _rc_policy["max_bytes"] is None:
+            return
+        prev = _rc_registry.pop(path, None)
+        if prev is not None:
+            _rc_total_bytes -= prev
+        _rc_registry[path] = int(nbytes)
+        _rc_total_bytes += int(nbytes)
+        while _rc_total_bytes > _rc_policy["max_bytes"] \
+                and len(_rc_registry) > 1:
+            victim, vbytes = next(iter(_rc_registry.items()))
+            del _rc_registry[victim]
+            _rc_total_bytes -= vbytes
+            evict.append(victim)
+            _rc_counts["evicted"] = _rc_counts.get("evicted", 0) + 1
+    for victim in evict:
+        fileio.remove(victim)
+
+
 class ShardCache:
     """Presence map + read/write for one cache prefix (mirrors
     FileShardCache, internal/slicecache/slicecache.go:38)."""
@@ -69,15 +212,22 @@ class ShardCache:
             self._usable(shard_path(prefix, s, num_shards))
             for s in range(num_shards)
         ]
+        for s, ok in enumerate(self.present):
+            if ok:  # presence scan == use: refresh LRU standing
+                _touch(shard_path(prefix, s, num_shards))
 
     @staticmethod
     def _usable(path: str) -> bool:
-        """A cached shard counts only if it exists AND carries the
-        current codec format (plain or zstd-compressed) — files from
-        older formats are cache misses (recompute + overwrite), not
-        runtime crashes. Mid-file corruption still fails loud at read
-        time (checksums). A 0-byte file is a legitimately empty shard
-        (its reader yielded no frames), not a format mismatch."""
+        """A cached shard counts only if it exists, is within the TTL
+        (expired files are removed and count as ``expired`` misses —
+        recompute + overwrite), AND carries the current codec format
+        (plain or zstd-compressed) — files from older formats are
+        cache misses, not runtime crashes. Mid-file corruption still
+        fails loud at read time (checksums). A 0-byte file is a
+        legitimately empty shard (its reader yielded no frames), not a
+        format mismatch."""
+        if _expired(path):
+            return False
         try:
             with fileio.open_read(path) as fp:
                 head = fp.read(4)
@@ -93,16 +243,18 @@ class ShardCache:
         return self.present[shard]
 
     def read(self, shard: int):
-        with fileio.open_read(
-            shard_path(self.prefix, shard, self.num_shards)
-        ) as fp:
+        path = shard_path(self.prefix, shard, self.num_shards)
+        _touch(path)
+        with fileio.open_read(path) as fp:
             yield from codec.read_stream(codec.maybe_decompressed(fp))
 
     def writethrough(self, shard: int, reader):
         """Tee a shard stream into the cache file, atomically (local
         tmp+rename; object-store PUT commit), zstd-compressed (the
         reference's slicecache writethrough; plain when zstd is
-        unavailable — reads sniff either)."""
+        unavailable — reads sniff either). The committed file joins
+        the LRU registry at its on-disk size, evicting colder entries
+        past the byte bound."""
         path = shard_path(self.prefix, shard, self.num_shards)
         with fileio.atomic_write(path) as fp:
             zw = codec.open_compressed_write(fp)
@@ -112,6 +264,10 @@ class ShardCache:
                 yield f
             if zw is not None:
                 zw.close()  # finalize the zstd frame; fp stays open
+        _touch(path, fileio.size(path))
+
+
+_END = object()  # stream-exhausted sentinel for the read fallback
 
 
 class _CachedSlice(Slice):
@@ -145,10 +301,30 @@ class _CachedSlice(Slice):
 
     def reader(self, shard, deps):
         if self._shard_cached(shard):
-            _record_result_cache("hit")
-            return self.cache.read(shard)
+            return self._read_or_recompute(shard, deps)
         _record_result_cache("miss")
         return self.cache.writethrough(shard, deps[0]())
+
+    def _read_or_recompute(self, shard, deps):
+        """Serve the cached shard; when the file vanished between the
+        presence scan and this read (a concurrent LRU eviction), fall
+        back to recompute + writethrough instead of crashing the task.
+        All-or-nothing caches whose dependency subgraph was dropped at
+        compile time have nothing to recompute from — the read error
+        stays loud there."""
+        try:
+            it = self.cache.read(shard)
+            first = next(it, _END)
+        except FileNotFoundError:
+            if not deps:
+                raise
+            _record_result_cache("miss")
+            yield from self.cache.writethrough(shard, deps[0]())
+            return
+        _record_result_cache("hit")
+        if first is not _END:
+            yield first
+            yield from it
 
 
 def Cache(slice_: Slice, prefix: str) -> Slice:

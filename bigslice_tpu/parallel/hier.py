@@ -32,6 +32,15 @@ Shard numbering over the 2-D mesh is row-major: global shard
 ``s = g * I + i`` lives on device (g, i) — matching
 ``mesh.devices.reshape(D, I)`` of the flat device list, so a 1-D
 shuffle over the same devices produces the same per-shard contents.
+
+The out-of-core shuffle plan (exec/shuffleplan.py) composes with this
+module unchanged: under ``BIGSLICE_SHUFFLE=spill`` each map-side wave
+still runs the two-stage hierarchical exchange built here — only the
+CROSS-WAVE merge's device residency is replaced by store-mediated
+spill entries, addressed through the same flat output contract
+(partition p on device p % N, wave-partitioned subid leading column)
+the executor's partition_cols helper reads back. Spill-vs-in-memory
+bit-parity on a (D, I) grid is pinned in tests/test_spill_shuffle.py.
 """
 
 from __future__ import annotations

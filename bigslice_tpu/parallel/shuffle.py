@@ -762,3 +762,30 @@ def _valid_chunks(c, counts, capacity: int, nshards: int) -> List[np.ndarray]:
     c = np.asarray(c)
     return [c[s * capacity : s * capacity + int(counts[s])]
             for s in range(nshards)]
+
+
+def partition_cols(chunks: Sequence[Sequence[np.ndarray]], partition: int,
+                   nmesh: int, subid: bool) -> List[np.ndarray]:
+    """ONE partition's valid rows from a partitioned group output's
+    host chunks (``unshard_columns`` layout: [ncols][ndevice]) — THE
+    host-side statement of the executor's partition-addressing
+    contract, shared by the store bridge's per-partition reads and the
+    spill exchange's per-partition writes so the two can never drift:
+    partition p lives on device ``p % nmesh``; wave-partitioned
+    outputs carry a leading int32 subid column selecting
+    ``p // nmesh`` (rows keep their device order — wave-major when the
+    cross-wave merge concatenated them)."""
+    dev_cols = [np.asarray(c[partition % nmesh]) for c in chunks]
+    if not subid:
+        return dev_cols
+    sel = dev_cols[0] == (partition // nmesh)
+    return [c[sel] for c in dev_cols[1:]]
+
+
+def partition_chunks(chunks: Sequence[Sequence[np.ndarray]],
+                     nparts: int, nmesh: int,
+                     subid: bool) -> List[List[np.ndarray]]:
+    """Every partition's valid rows (see ``partition_cols``), in
+    partition order — the spill exchange's map-side split."""
+    return [partition_cols(chunks, p, nmesh, subid)
+            for p in range(nparts)]

@@ -241,6 +241,8 @@ class ServeServer(DebugServer):
                  queue_depth: int = 16,
                  tenant_quota: Optional[int] = None,
                  result_cache_dir: Optional[str] = None,
+                 result_cache_ttl_s: Optional[float] = ...,
+                 result_cache_max_bytes: Optional[int] = ...,
                  default_tenant: str = "default"):
         self._pipelines: Dict[str, Pipeline] = {}
         self._pipe_lock = threading.Lock()
@@ -248,6 +250,16 @@ class ServeServer(DebugServer):
         self.queue_depth = max(0, int(queue_depth))
         self.tenant_quota = tenant_quota
         self.result_cache_dir = result_cache_dir
+        # Result-cache eviction policy (ops/cache.py: TTL + byte-
+        # bounded LRU — PR-14's named follow-on; entries no longer
+        # live forever). Omitted arguments keep the env-seeded policy
+        # (BIGSLICE_RESULT_CACHE_TTL_S / _MAX_BYTES); None disables.
+        if result_cache_ttl_s is not ... or \
+                result_cache_max_bytes is not ...:
+            from bigslice_tpu.ops.cache import configure_result_cache
+
+            configure_result_cache(ttl_s=result_cache_ttl_s,
+                                   max_bytes=result_cache_max_bytes)
         self.default_tenant = default_tenant
         self.stats = ServingStats()
         # Admission state: one lock guards the active/queued counters
@@ -366,9 +378,12 @@ class ServeServer(DebugServer):
             program_cache_stats,
         )
 
+        from bigslice_tpu.ops.cache import result_cache_policy
+
         doc = self.stats.summary()
         doc["program_cache"] = program_cache_stats()
         doc["result_cache"] = result_cache_counts()
+        doc["result_cache_policy"] = result_cache_policy()
         doc["admission"] = {
             "slots": self.slots,
             "queue_depth": self.queue_depth,

@@ -130,6 +130,12 @@ def main(argv=None) -> int:
     p.add_argument("-nkeys", type=int, default=199)
     p.add_argument("-mesh", action="store_true",
                    help="run on the mesh executor (CPU mesh in tests)")
+    p.add_argument("-shuffle", default=None,
+                   choices=("in_program", "spill", "auto"),
+                   help="force the shuffle plan (BIGSLICE_SHUFFLE) for "
+                        "both runs — 'spill' exercises the out-of-core "
+                        "spill exchange's spill.read/spill.write sites "
+                        "(mesh executor only)")
     p.add_argument("-elastic", type=int, default=2,
                    help="elastic mesh-recovery retries (mesh only)")
     p.add_argument("-json", dest="json_path", default=None)
@@ -157,26 +163,39 @@ def main(argv=None) -> int:
         return 2
 
     elastic = args.elastic if args.mesh else 0
-    with tempfile.TemporaryDirectory(prefix="chaosslice-") as tmp:
-        # Fault-free baseline first: the ground truth the chaos run
-        # must match bit-for-bit.
-        faultinject.clear()
-        baseline, _, base_wall = _run_once(
-            args.mesh, f"{tmp}/base", args.rows, args.shards,
-            args.nkeys,
-        )
-        plan = faultinject.install(parsed)
-        err = None
-        try:
-            chaos_rows, summary, chaos_wall = _run_once(
-                args.mesh, f"{tmp}/chaos", args.rows, args.shards,
-                args.nkeys, elastic=elastic,
-            )
-        except Exception as e:  # noqa: BLE001 — reported, not raised
-            err = e
-            chaos_rows, summary, chaos_wall = None, {}, 0.0
-        finally:
+    prev_shuffle = os.environ.get("BIGSLICE_SHUFFLE")
+    if args.shuffle:
+        # Both runs (baseline AND chaos) take the forced plan, so the
+        # bit-identical verdict measures recovery, not the exchange.
+        os.environ["BIGSLICE_SHUFFLE"] = args.shuffle
+    try:
+        with tempfile.TemporaryDirectory(prefix="chaosslice-") as tmp:
+            # Fault-free baseline first: the ground truth the chaos run
+            # must match bit-for-bit.
             faultinject.clear()
+            baseline, _, base_wall = _run_once(
+                args.mesh, f"{tmp}/base", args.rows, args.shards,
+                args.nkeys,
+            )
+            plan = faultinject.install(parsed)
+            err = None
+            try:
+                chaos_rows, summary, chaos_wall = _run_once(
+                    args.mesh, f"{tmp}/chaos", args.rows, args.shards,
+                    args.nkeys, elastic=elastic,
+                )
+            except Exception as e:  # noqa: BLE001 — reported, never
+                err = e              # raised
+                chaos_rows, summary, chaos_wall = None, {}, 0.0
+            finally:
+                faultinject.clear()
+    finally:
+        # In-process callers (tests) must not inherit the forced plan.
+        if args.shuffle:
+            if prev_shuffle is None:
+                os.environ.pop("BIGSLICE_SHUFFLE", None)
+            else:
+                os.environ["BIGSLICE_SHUFFLE"] = prev_shuffle
 
     snap = plan.snapshot()
     recovery = summary.get("recovery", {})
@@ -202,6 +221,7 @@ def main(argv=None) -> int:
         doc = {
             "spec": spec,
             "mesh": bool(args.mesh),
+            "shuffle": args.shuffle,
             "rows": args.rows,
             "shards": args.shards,
             "ok": err is None,

@@ -130,6 +130,7 @@ def _print_inv(out: List[str], inv, summary: dict, tasks: List[dict],
     _print_device(out, inv, telem.get("hbm", ()),
                   telem.get("donation", ()))
     _print_exchange(out, inv, telem.get("exchange", ()))
+    _print_spill(out, inv, telem.get("spill", ()))
     out.append("")
 
 
@@ -364,6 +365,35 @@ def _print_exchange(out: List[str], inv, events):
         )
 
 
+def _print_spill(out: List[str], inv, events):
+    """Per-boundary shuffle-plan decisions from bigslice:spill
+    instants (exec/shuffleplan.py): the chosen exchange, the
+    estimate-vs-budget evidence, and what the store-mediated spill
+    path moved (bytes, partitions, map waves → reduce sub-waves)."""
+    if not events:
+        return
+    out.append(f"# inv{inv}:spill (shuffle plan / out-of-core spill)")
+    out.append(f"  {'op':<28} {'plan':>10} {'est_MB':>8} "
+               f"{'budget_MB':>9} {'spill_MB':>9} {'parts':>6} "
+               f"{'waves':>5} {'subw':>5}  reason")
+    for ev in events[-16:]:
+        a = ev.get("args", {})
+
+        def mb(v):
+            return f"{(v or 0) / 1e6:.1f}" if v else "-"
+
+        out.append(
+            f"  {str(a.get('op', '?'))[:28]:<28} "
+            f"{str(a.get('plan', '?')):>10} "
+            f"{mb(a.get('est_bytes')):>8} "
+            f"{mb(a.get('budget_bytes')):>9} "
+            f"{mb(a.get('spill_bytes')):>9} "
+            f"{a.get('partitions', 0):>6} "
+            f"{a.get('map_waves', 0):>5} "
+            f"{a.get('sub_waves', 0):>5}  {a.get('reason', '')}"
+        )
+
+
 def analyze(path: str) -> str:
     with open(path) as fp:
         doc = json.load(fp)
@@ -379,6 +409,7 @@ def analyze(path: str) -> str:
         "bigslice:hbm": "hbm",
         "bigslice:donation": "donation",
         "bigslice:exchange": "exchange",
+        "bigslice:spill": "spill",
     }
     n_tasks = n_instants = 0
     for ev in doc.get("traceEvents", []):

@@ -334,6 +334,18 @@ class _OpDeviceRecord:
         self.ici_bytes = 0
         self.flat_dcn_messages = 0
         self.flat_dcn_bytes = 0
+        # shuffle-plan attribution (exec/shuffleplan.py): per-boundary
+        # exchange choice + the spill path's written bytes/partitions
+        # and its map-wave / reduce-sub-wave schedule.
+        self.plan_counts: Dict[str, int] = {}
+        self.plan_reason = ""
+        self.plan_est_bytes = 0
+        self.plan_budget_bytes = 0
+        self.spill_bytes = 0
+        self.spill_rows = 0
+        self.spill_partitions = 0
+        self.spill_map_waves = 0
+        self.spill_sub_waves = 0
 
 
 class DeviceTelemetry:
@@ -592,22 +604,75 @@ class DeviceTelemetry:
                    flat_dcn_messages=int(flat_dcn_messages),
                    flat_dcn_bytes=int(flat_dcn_bytes))
 
+    # -- shuffle-plan attribution (out-of-core spill exchange) ------------
+
+    def record_shuffle_plan(self, op: str, inv: Optional[int],
+                            plan: str, reason: str = "",
+                            est_bytes: Optional[int] = None,
+                            budget_bytes: Optional[int] = None,
+                            spill_bytes: int = 0, spill_rows: int = 0,
+                            partitions: int = 0, map_waves: int = 0,
+                            sub_waves: int = 0) -> None:
+        """One shuffle boundary's exchange decision
+        (exec/shuffleplan.py): ``plan`` is ``in_program`` or ``spill``,
+        ``reason`` why (forced knob / budget estimate / ineligibility),
+        and the spill fields describe what the store-mediated exchange
+        actually moved — bytes/rows written, distinct partitions, and
+        the map-wave → reduce-sub-wave schedule."""
+        with self._lock:
+            rec = self._op(op, inv)
+            rec.plan_counts[plan] = rec.plan_counts.get(plan, 0) + 1
+            rec.plan_reason = reason
+            if est_bytes:
+                rec.plan_est_bytes = max(rec.plan_est_bytes,
+                                         int(est_bytes))
+            if budget_bytes:
+                rec.plan_budget_bytes = int(budget_bytes)
+            rec.spill_bytes += max(0, int(spill_bytes))
+            rec.spill_rows += max(0, int(spill_rows))
+            rec.spill_partitions += max(0, int(partitions))
+            if map_waves:
+                rec.spill_map_waves = int(map_waves)
+            if sub_waves:
+                rec.spill_sub_waves = int(sub_waves)
+        self._emit("bigslice:spill", op=op, inv=inv, plan=plan,
+                   reason=reason, est_bytes=est_bytes,
+                   budget_bytes=budget_bytes,
+                   spill_bytes=int(spill_bytes),
+                   spill_rows=int(spill_rows),
+                   partitions=int(partitions),
+                   map_waves=int(map_waves),
+                   sub_waves=int(sub_waves))
+
+    def hbm_budget(self) -> Optional[int]:
+        """The measured aggregate device-memory limit the HBM sampler
+        observed (backend allocator ``bytes_limit``; None where no
+        backend reports one, e.g. virtual CPU meshes) — the
+        ``auto`` shuffle planner's budget source when no explicit
+        knob is set."""
+        with self._lock:
+            return self._hbm_limit_bytes
+
     # -- queries ----------------------------------------------------------
 
     def status_line(self) -> Optional[str]:
-        """The live ``hbm %`` annotation for the status display."""
+        """The live ``hbm %`` annotation for the status display, plus
+        a spill tail when the out-of-core exchange is active."""
         with self._lock:
             if not self._hbm:
                 return None
             cur = self._hbm[-1]
             peak = self._hbm_peak_bytes
             limit = self._hbm_limit_bytes
+            spill = sum(r.spill_bytes for r in self._ops.values())
+        tail = f", spilled {spill / 1e6:.0f}MB" if spill else ""
         mb = cur["bytes_in_use"] / 1e6
         if limit:
             return (f"  hbm {100.0 * cur['bytes_in_use'] / limit:.0f}%"
                     f" in use ({mb:.0f}MB,"
-                    f" peak {100.0 * peak / limit:.0f}%)")
-        return f"  device mem {mb:.0f}MB in use (no allocator limit)"
+                    f" peak {100.0 * peak / limit:.0f}%{tail})")
+        return (f"  device mem {mb:.0f}MB in use (no allocator "
+                f"limit{tail})")
 
     def summary(self) -> dict:
         """The ``telemetry_summary()["device"]`` payload."""
@@ -617,6 +682,11 @@ class DeviceTelemetry:
             tot_wall = tot_flops = tot_bytes = 0.0
             donation = {}
             don_expected = don_aliased = 0
+            shuffle_plan: dict = {}
+            sp_tot: dict = {"spill_bytes": 0, "spill_rows": 0,
+                            "spill_partitions": 0,
+                            "spill_boundaries": 0,
+                            "in_program_boundaries": 0}
             exchange = {}
             ex_tot = {"dcn_messages": 0, "dcn_bytes": 0,
                       "ici_messages": 0, "ici_bytes": 0,
@@ -654,6 +724,46 @@ class DeviceTelemetry:
                     }
                     don_expected += rec.donation_expected_bytes
                     don_aliased += rec.donation_aliased_bytes
+                if rec.plan_counts:
+                    entry = {
+                        "plans": dict(rec.plan_counts),
+                        "reason": rec.plan_reason,
+                    }
+                    if rec.plan_est_bytes:
+                        entry["est_bytes"] = rec.plan_est_bytes
+                    if rec.plan_budget_bytes:
+                        entry["budget_bytes"] = rec.plan_budget_bytes
+                    if rec.spill_bytes or rec.plan_counts.get("spill"):
+                        entry.update({
+                            "spill_bytes": rec.spill_bytes,
+                            "spill_rows": rec.spill_rows,
+                            "partitions": rec.spill_partitions,
+                            "map_waves": rec.spill_map_waves,
+                            "sub_waves": rec.spill_sub_waves,
+                        })
+                        # The per-wave watermark evidence for THIS op:
+                        # the max HBM sample stamped with it — the
+                        # line the out-of-core acceptance holds
+                        # against the budget.
+                        op_hbm = [
+                            s["bytes_in_use"] for s in self._hbm
+                            if s.get("op") == op
+                        ]
+                        if op_hbm:
+                            entry["max_wave_hbm_bytes"] = max(op_hbm)
+                    shuffle_plan[op] = entry
+                    sp_tot["spill_bytes"] += rec.spill_bytes
+                    sp_tot["spill_rows"] += rec.spill_rows
+                    sp_tot["spill_partitions"] += rec.spill_partitions
+                    sp_tot["spill_boundaries"] += \
+                        rec.plan_counts.get("spill", 0)
+                    sp_tot["in_program_boundaries"] += \
+                        rec.plan_counts.get("in_program", 0)
+                    if rec.plan_budget_bytes:
+                        sp_tot["budget_bytes"] = max(
+                            sp_tot.get("budget_bytes", 0),
+                            rec.plan_budget_bytes,
+                        )
                 if rec.exchange_waves:
                     entry = {
                         "waves": rec.exchange_waves,
@@ -707,11 +817,23 @@ class DeviceTelemetry:
                     ex_tot["flat_dcn_messages"]
                     / ex_tot["dcn_messages"], 4
                 )
+        splan: dict = {}
+        if shuffle_plan:
+            # The per-boundary plan choices plus the watermark line the
+            # out-of-core acceptance keys on: the session-wide HBM peak
+            # held against the spill budget.
+            splan = {"ops": shuffle_plan, "totals": dict(sp_tot)}
+            splan["totals"]["hbm_peak_bytes"] = self._hbm_peak_bytes
+            if sp_tot.get("budget_bytes"):
+                splan["totals"]["within_budget"] = bool(
+                    self._hbm_peak_bytes <= sp_tot["budget_bytes"]
+                )
         out = {
             "compile": compile_ops,
             "hbm": hbm,
             "donation": donation,
             "exchange": exchange,
+            "shuffle_plan": splan,
             "totals": totals,
         }
         return out
@@ -794,6 +916,28 @@ class DeviceTelemetry:
                          {"op": op, "axis": axis}, msgs)
                     line("bigslice_exchange_bytes_total",
                          {"op": op, "axis": axis}, nbytes)
+        if any(rec.plan_counts for rec in ops.values()):
+            metric("bigslice_shuffle_plan_total",
+                   "Shuffle-boundary exchange decisions per op "
+                   "(in_program vs store-mediated spill; "
+                   "exec/shuffleplan.py).", "counter")
+            metric("bigslice_shuffle_spill_bytes_total",
+                   "Bytes written through the out-of-core spill "
+                   "exchange per op.", "counter")
+            metric("bigslice_shuffle_spill_partitions_total",
+                   "Spill-store partition entries written per op "
+                   "(one per map wave x nonempty partition).",
+                   "counter")
+            for op, rec in ops.items():
+                for plan, n in sorted(rec.plan_counts.items()):
+                    line("bigslice_shuffle_plan_total",
+                         {"op": op, "plan": plan}, n)
+                if rec.spill_bytes:
+                    line("bigslice_shuffle_spill_bytes_total",
+                         {"op": op}, rec.spill_bytes)
+                if rec.spill_partitions:
+                    line("bigslice_shuffle_spill_partitions_total",
+                         {"op": op}, rec.spill_partitions)
         if hbm_last is not None:
             metric("bigslice_hbm_bytes",
                    "Device-memory watermark (max across devices; "

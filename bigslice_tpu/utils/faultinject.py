@@ -88,6 +88,14 @@ _site("staging.assemble", ("io",),
 _site("shuffle.upload", ("io",),
       "place_global_columns (batched device_put) entry: transient "
       "failure, retried")
+_site("spill.write", ("io",),
+      "SpillExchange.put_partition entry (out-of-core shuffle spill "
+      "write): transient failure before any frame is built, retried "
+      "with bounded backoff")
+_site("spill.read", ("lose",),
+      "SpillExchange.read_partition: a spilled shuffle partition "
+      "vanishes (file dropped) -> Missing -> DepLost -> the producer "
+      "group recomputes and re-spills")
 _site("mesh.dispatch", ("infra", "hostloss"),
       "SPMD group dispatch: 'infra' = XLA-runtime-class failure "
       "(probation -> host-tier resubmit); 'hostloss' = gang-member loss "

@@ -26,7 +26,7 @@ from __future__ import annotations
 import contextlib
 import os
 import tempfile
-from typing import BinaryIO, Callable, Iterator, Tuple
+from typing import BinaryIO, Callable, Iterator, Optional, Tuple
 
 from bigslice_tpu.utils import faultinject
 
@@ -109,6 +109,36 @@ def open_read(path: str) -> BinaryIO:
         return open(path, "rb")
 
     return retry_transient(attempt, f"open {path}")
+
+
+def size(path: str) -> Optional[int]:
+    """File size in bytes, or None when unknowable (missing file,
+    object store without a size field). Best-effort — cache-eviction
+    accounting, never a correctness input."""
+    with contextlib.suppress(Exception):
+        if is_url(path):
+            fs, p = _fs(path)
+            v = fs.info(p).get("size")
+            return int(v) if v is not None else None
+        return os.stat(path).st_size
+    return None
+
+
+def mtime(path: str) -> Optional[float]:
+    """Last-modified time as a POSIX timestamp, or None when
+    unknowable. Best-effort — TTL expiry input, never correctness."""
+    with contextlib.suppress(Exception):
+        if is_url(path):
+            fs, p = _fs(path)
+            m = fs.info(p).get("mtime") or fs.info(p).get(
+                "LastModified"
+            )
+            if m is None:
+                return None
+            ts = getattr(m, "timestamp", None)
+            return float(ts() if callable(ts) else m)
+        return os.stat(path).st_mtime
+    return None
 
 
 def remove(path: str) -> None:

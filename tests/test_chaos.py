@@ -491,3 +491,108 @@ def test_chaosslice_cli_local(tmp_path, capsys):
     assert doc["ok"] and doc["bit_identical"]
     assert any(r["site"] == "store.read" for r in doc["matrix"])
     assert faultinject.active_plan() is None  # CLI cleans up
+
+
+# -- the out-of-core spill exchange's chaos sites -------------------------
+#
+# Under BIGSLICE_SHUFFLE=spill every shuffle boundary writes its
+# partitions through the spill FileStore (exec/shuffleplan.py), so the
+# run exercises the new spill.write/spill.read seams plus the existing
+# codec corruption -> quarantine ladder on the spilled files.
+
+
+@pytest.fixture
+def spill_mode(monkeypatch):
+    monkeypatch.setenv("BIGSLICE_SHUFFLE", "spill")
+
+
+def _spill_run(keys, vals, elastic=0, **ex):
+    from bigslice_tpu.exec.meshexec import MeshExecutor
+
+    sess = Session(executor=MeshExecutor(_mesh(), **ex),
+                   elastic=elastic)
+    res = sess.run(bs.Reduce(bs.Const(16, keys, vals),
+                             lambda a, b: a + b))
+    rows = list(map(tuple, res.rows()))
+    return rows, sess
+
+
+def test_spill_write_transient_retried(spill_mode, chaos):
+    keys, vals = _keyed()
+    base, _ = _spill_run(keys, vals)
+    assert dict(base) == _reduce_oracle(keys, vals)
+    plan = chaos("3:spill.write=1.0x2")
+    got, sess = _spill_run(keys, vals)
+    assert got == base  # raw order included: retried, not degraded
+    assert plan.snapshot()["injected"] == {"spill.write": 2}
+    # Transient write retries never lose a task.
+    assert sess.telemetry_summary().get("recovery") is None
+
+
+def test_spill_read_loss_recomputes_bit_identical(spill_mode, chaos):
+    """An injected spill-partition loss surfaces as Missing ->
+    DepLost for the WHOLE producer group (a spilled partition holds
+    every shard's rows) -> the group re-runs, re-spills, and the
+    consumer completes bit-identical; the recovery is attributed to
+    the spill.read site."""
+    keys, vals = _keyed()
+    base, _ = _spill_run(keys, vals)
+    plan = chaos("5:spill.read=1.0x1")
+    got, sess = _spill_run(keys, vals)
+    assert got == base
+    assert plan.snapshot()["injected"] == {"spill.read": 1}
+    rec = sess.telemetry_summary()["recovery"]
+    assert rec["fatal_total"] == 0
+    site = rec["by_site"]["spill.read"]
+    assert site["recovered"] > 0 and site["fatal"] == 0
+
+
+def test_spill_corruption_quarantined_and_recovers(spill_mode, chaos):
+    """Bit-flip corruption of a spilled frame rides the organic
+    CorruptionError -> quarantine -> Missing -> recompute ladder of
+    the spill FileStore (PR 5's machinery, by construction)."""
+    keys, vals = _keyed()
+    base, _ = _spill_run(keys, vals)
+    chaos("9:codec.read=1.0x1~flip")
+    got, sess = _spill_run(keys, vals, prefetch_depth=0)
+    assert got == base
+    spill_store = sess.executor._spill
+    assert spill_store is not None and spill_store.quarantined >= 1
+
+
+def test_spill_loss_under_elastic_recovery(spill_mode, chaos,
+                                           monkeypatch):
+    """A gang-member loss mid-run under the spill plan: elastic mesh
+    recovery re-forms the mesh and the rerun — re-reading or
+    re-spilling as needed — stays bit-identical."""
+    monkeypatch.setenv("BIGSLICE_ELASTIC_BACKOFF", "0.01")
+    keys, vals = _keyed()
+    base, _ = _spill_run(keys, vals)
+    plan = chaos("9:mesh.dispatch=1.0x1~hostloss")
+    got, sess = _spill_run(keys, vals, elastic=1)
+    assert got == base
+    assert plan.snapshot()["injected"] == {"mesh.dispatch": 1}
+    tot = sess.telemetry_summary()["device"]["shuffle_plan"]["totals"]
+    assert tot["spill_boundaries"] >= 1
+
+
+def test_chaosslice_cli_spill(tmp_path, capsys, monkeypatch):
+    from bigslice_tpu.tools import chaosslice
+
+    # The CLI exports BIGSLICE_SHUFFLE for its runs; seed it through
+    # monkeypatch so the env mutation is undone at teardown.
+    monkeypatch.setenv("BIGSLICE_SHUFFLE", "spill")
+    out_json = tmp_path / "spill-matrix.json"
+    rc = chaosslice.main([
+        "-chaos", "7:spill.read=0.5x2,spill.write=0.5x2",
+        "-rows", "4000", "-shards", "16", "-mesh",
+        "-shuffle", "spill", "-json", str(out_json),
+    ])
+    captured = capsys.readouterr().out
+    assert rc == 0, captured
+    assert "bit-identical" in captured
+    doc = json.loads(out_json.read_text())
+    assert doc["ok"] and doc["bit_identical"]
+    assert doc["shuffle"] == "spill"
+    sites = {r["site"] for r in doc["matrix"]}
+    assert sites & {"spill.read", "spill.write"}, doc["matrix"]

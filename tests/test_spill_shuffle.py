@@ -1,0 +1,437 @@
+"""The out-of-core pluggable shuffle (exec/shuffleplan.py): planner
+units, spill-vs-in-memory bit parity across op shapes and mesh
+topologies, sub-wave re-combine correctness on wave-partitioned
+(subid) boundaries, budget/watermark attribution, and the spill
+read-ahead warm path.
+
+The contract under test: ``BIGSLICE_SHUFFLE`` unset is bit-identical
+to the pre-seam executor (chicken bit); ``spill`` routes every
+eligible shuffle boundary through the store-mediated exchange with
+bit-identical results; ``auto`` spills exactly when the staged-input
+estimate exceeds the spill budget."""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+import bigslice_tpu as bs
+from bigslice_tpu.exec import shuffleplan
+from bigslice_tpu.exec.meshexec import MeshExecutor
+from bigslice_tpu.exec.session import Session
+
+
+def _add(a, b):
+    return a + b
+
+
+def _flat_mesh():
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(jax.devices()[:8]), ("shards",))
+
+
+def _grid_mesh():
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(jax.devices()[:8]).reshape(2, 4),
+                ("dcn", "ici"))
+
+
+def _keyed(rows=20000, nkeys=251, seed=7):
+    rng = np.random.RandomState(seed)
+    return (rng.randint(0, nkeys, rows).astype(np.int32),
+            rng.randint(0, 50, rows).astype(np.int32))
+
+
+@pytest.fixture(autouse=True)
+def _no_shuffle_env(monkeypatch):
+    monkeypatch.delenv("BIGSLICE_SHUFFLE", raising=False)
+    monkeypatch.delenv("BIGSLICE_SPILL_BUDGET_BYTES", raising=False)
+
+
+def _run(slice_fn, mode=None, mesh=None, monkeypatch=None, **ex):
+    if mode is not None:
+        os.environ["BIGSLICE_SHUFFLE"] = mode
+    else:
+        os.environ.pop("BIGSLICE_SHUFFLE", None)
+    try:
+        sess = Session(executor=MeshExecutor(mesh or _flat_mesh(),
+                                             **ex))
+        res = sess.run(slice_fn())
+        rows = list(map(tuple, res.rows()))
+        summary = sess.telemetry_summary()
+        assert sess.executor.device_group_count() > 0
+        sess.shutdown()
+        return rows, summary
+    finally:
+        os.environ.pop("BIGSLICE_SHUFFLE", None)
+
+
+def _spill_totals(summary):
+    return summary["device"]["shuffle_plan"].get("totals", {})
+
+
+# -- planner units --------------------------------------------------------
+
+
+def test_plan_mode_parses_and_rejects(monkeypatch):
+    monkeypatch.delenv("BIGSLICE_SHUFFLE", raising=False)
+    assert shuffleplan.plan_mode() is None
+    for m in shuffleplan.MODES:
+        monkeypatch.setenv("BIGSLICE_SHUFFLE", m)
+        assert shuffleplan.plan_mode() == m
+    monkeypatch.setenv("BIGSLICE_SHUFFLE", "bogus")
+    with pytest.raises(ValueError):
+        shuffleplan.plan_mode()
+
+
+def test_choose_knob_forcing():
+    assert shuffleplan.choose(None, None, None) is None
+    plan = shuffleplan.choose("spill", None, None)
+    assert (plan.kind, plan.reason) == ("spill", "forced")
+    plan = shuffleplan.choose("in_program", None, None)
+    assert plan.kind == "in_program"
+    # Ineligible boundaries never spill, and say why.
+    plan = shuffleplan.choose("spill", None, None,
+                              ineligible="machine-combiner buffer")
+    assert plan.kind == "in_program"
+    assert "machine-combiner" in plan.reason
+
+
+def test_choose_budget_thresholds():
+    over = shuffleplan.choose("auto", est_bytes=200, budget_bytes=100)
+    assert (over.kind, over.reason) == ("spill", "estimate")
+    under = shuffleplan.choose("auto", est_bytes=50, budget_bytes=100)
+    assert under.kind == "in_program"
+    # No budget / no estimate: conservative in-program.
+    assert shuffleplan.choose("auto", None, None).kind == "in_program"
+    assert shuffleplan.choose("auto", 1 << 40, None).kind == \
+        "in_program"
+
+
+def test_spill_budget_sources(monkeypatch):
+    monkeypatch.setenv("BIGSLICE_SPILL_BUDGET_BYTES", "12345")
+    assert shuffleplan.spill_budget_bytes() == 12345
+    monkeypatch.delenv("BIGSLICE_SPILL_BUDGET_BYTES")
+    # Measured HBM limit (PR-6 watermark sampler) is the second source.
+    from bigslice_tpu.utils.devicetelemetry import DeviceTelemetry
+
+    dev = DeviceTelemetry()
+    assert shuffleplan.spill_budget_bytes(dev) is None
+    dev.record_hbm(10, 10, 1 << 30)
+    assert shuffleplan.spill_budget_bytes(dev) == 1 << 30
+    # Aggregate per-device working-set budget is the fallback.
+    assert shuffleplan.spill_budget_bytes(
+        None, device_budget_bytes=100, nmesh=8
+    ) == 800
+
+
+def test_machine_combined_boundary_is_ineligible():
+    keys, vals = _keyed(4000)
+    sess = Session(machine_combiners=True)
+    try:
+        res = sess.run(bs.Reduce(bs.Const(4, keys, vals), _add))
+        tasks = res.tasks
+        from bigslice_tpu.exec.task import iter_tasks
+
+        stamped = [t for t in iter_tasks(tasks)
+                   if getattr(t, "spill_ineligible", None)]
+        assert stamped, "no machine-combined producer stamped"
+        assert all(shuffleplan.spill_ineligible(t) for t in stamped)
+    finally:
+        sess.shutdown()
+
+
+# -- bit parity: spill vs in-memory ---------------------------------------
+
+
+def test_reduce_spill_bit_parity_waved_subid():
+    """Keyed reduce with 32 shards on 8 devices: the boundary is
+    wave-partitioned (nparts > nmesh, subid routing) and the map side
+    runs 4 waves — the full sub-wave re-combine shape. RAW row order
+    compared, not just sorted: the spill read-back must reproduce the
+    in-program merge's wave-major order."""
+    keys, vals = _keyed()
+
+    def slice_fn():
+        return bs.Reduce(bs.Const(32, keys, vals), _add)
+
+    mem, _ = _run(slice_fn)
+    spill, summary = _run(slice_fn, mode="spill")
+    assert spill == mem
+    oracle = {}
+    for k, v in zip(keys.tolist(), vals.tolist()):
+        oracle[k] = oracle.get(k, 0) + v
+    assert dict(spill) == oracle
+    tot = _spill_totals(summary)
+    assert tot["spill_boundaries"] >= 1
+    assert tot["spill_bytes"] > 0
+    ops = summary["device"]["shuffle_plan"]["ops"]
+    (entry,) = [e for e in ops.values() if e["plans"].get("spill")]
+    assert entry["map_waves"] == 4
+    assert entry["sub_waves"] == 4
+    assert entry["partitions"] > 0
+
+
+@pytest.mark.parametrize("prefetch,arena", [(0, True), (2, False)])
+def test_reduce_spill_parity_across_pipeline_knobs(prefetch, arena):
+    keys, vals = _keyed(12000)
+
+    def slice_fn():
+        return bs.Reduce(bs.Const(32, keys, vals), _add)
+
+    mem, _ = _run(slice_fn)
+    spill, _ = _run(slice_fn, mode="spill", prefetch_depth=prefetch,
+                    staging_arena=arena)
+    assert spill == mem
+
+
+def test_reduce_spill_parity_hier_2x4():
+    """On the 2-D DCN × ICI grid the map waves still run the
+    hierarchical two-stage exchange; only the cross-wave merge's
+    residency moves to the spill store."""
+    keys, vals = _keyed()
+
+    def slice_fn():
+        return bs.Reduce(bs.Const(16, keys, vals), _add)
+
+    mem, _ = _run(slice_fn, mesh=_grid_mesh())
+    spill, summary = _run(slice_fn, mode="spill", mesh=_grid_mesh())
+    assert spill == mem
+    assert _spill_totals(summary)["spill_boundaries"] >= 1
+    # The hierarchical exchange ran (DCN traffic recorded), spilled.
+    assert summary["device"]["totals"]["dcn_messages"] > 0
+
+
+def test_groupby_spill_parity():
+    keys, vals = _keyed(10000, nkeys=17)
+
+    def slice_fn():
+        return bs.GroupByKey(bs.Const(16, keys, vals), capacity=4096)
+
+    mem, _ = _run(slice_fn)
+    spill, _ = _run(slice_fn, mode="spill")
+    assert repr(mem) == repr(spill)
+
+
+def test_join_spill_parity_both_sides():
+    ak, av = _keyed(12000)
+    bk, bv = _keyed(12000, seed=11)
+
+    def slice_fn():
+        return bs.JoinAggregate(bs.Const(16, ak, av),
+                                bs.Const(16, bk, bv), _add, _add)
+
+    mem, _ = _run(slice_fn)
+    spill, summary = _run(slice_fn, mode="spill")
+    assert spill == mem
+    # Both input boundaries spilled.
+    assert _spill_totals(summary)["spill_boundaries"] == 2
+
+
+def test_unset_knob_plans_nothing():
+    keys, vals = _keyed(8000)
+
+    def slice_fn():
+        return bs.Reduce(bs.Const(16, keys, vals), _add)
+
+    _, summary = _run(slice_fn)
+    # Chicken bit: planner fully disengaged — no plan section at all.
+    assert summary["device"]["shuffle_plan"] == {}
+
+
+# -- auto mode: estimate vs budget ----------------------------------------
+
+
+def test_auto_spills_under_tight_budget(monkeypatch):
+    keys, vals = _keyed()
+
+    def slice_fn():
+        return bs.Reduce(bs.Const(32, keys, vals), _add)
+
+    mem, _ = _run(slice_fn)
+    monkeypatch.setenv("BIGSLICE_SPILL_BUDGET_BYTES", "100000")
+    spill, summary = _run(slice_fn, mode="auto")
+    assert spill == mem
+    tot = _spill_totals(summary)
+    assert tot["spill_boundaries"] >= 1
+    assert tot["budget_bytes"] == 100000
+    # The evidence trail: estimate exceeded budget, and the section
+    # carries the HBM watermark line the acceptance keys on.
+    ops = summary["device"]["shuffle_plan"]["ops"]
+    (entry,) = [e for e in ops.values() if e["plans"].get("spill")]
+    assert entry["reason"] == "estimate"
+    assert entry["est_bytes"] > entry["budget_bytes"]
+    assert "max_wave_hbm_bytes" in entry
+    assert "hbm_peak_bytes" in tot and "within_budget" in tot
+
+
+def test_auto_stays_in_program_under_loose_budget(monkeypatch):
+    keys, vals = _keyed(8000)
+
+    def slice_fn():
+        return bs.Reduce(bs.Const(32, keys, vals), _add)
+
+    mem, _ = _run(slice_fn)
+    monkeypatch.setenv("BIGSLICE_SPILL_BUDGET_BYTES", str(1 << 40))
+    rows, summary = _run(slice_fn, mode="auto")
+    assert rows == mem
+    tot = _spill_totals(summary)
+    assert tot["spill_boundaries"] == 0
+    assert tot["in_program_boundaries"] >= 1
+
+
+# -- spill mechanics -------------------------------------------------------
+
+
+def test_spill_prefetch_warms_partitions(monkeypatch):
+    """The reduce-side prefetcher hints sub-wave N+1's partitions into
+    the spill FileStore's warm cache (the PR-1 machinery, taught about
+    spill partitions)."""
+    keys, vals = _keyed()
+    os.environ["BIGSLICE_SHUFFLE"] = "spill"
+    try:
+        from bigslice_tpu.exec import store as store_mod
+
+        warmed = []
+        orig = store_mod.FileStore.prefetch
+
+        def spy(self, name, partition):
+            warmed.append((str(name), partition))
+            return orig(self, name, partition)
+
+        monkeypatch.setattr(store_mod.FileStore, "prefetch", spy)
+        sess = Session(executor=MeshExecutor(_flat_mesh(),
+                                             prefetch_depth=1))
+        res = sess.run(bs.Reduce(bs.Const(32, keys, vals), _add))
+        rows = sorted(res.rows())
+        assert rows
+        sess.shutdown()
+        spill_hints = [w for w in warmed if "~spill" in w[0]]
+        assert spill_hints, "no spill partitions were warmed"
+    finally:
+        os.environ.pop("BIGSLICE_SHUFFLE", None)
+
+
+def test_spill_entries_discard_and_tmp_cleanup():
+    keys, vals = _keyed(8000)
+    os.environ["BIGSLICE_SHUFFLE"] = "spill"
+    try:
+        ex = MeshExecutor(_flat_mesh())
+        sess = Session(executor=ex)
+        res = sess.run(bs.Reduce(bs.Const(16, keys, vals), _add))
+        assert sorted(res.rows())
+        tmp = ex._spill_tmp
+        assert tmp and os.path.isdir(tmp)
+        # Entries exist while the output lives (Result reuse reads
+        # them like any other intermediate)...
+        assert [p for p, _, files in os.walk(tmp) if files]
+        # ...and discarding the producing group retires them.
+        producer = next(
+            name for name, (key, _) in ex._task_index.items()
+            if isinstance(ex._outputs.get(key),
+                          shuffleplan.SpilledGroupOutput)
+        )
+        ex.discard(ex._task_index[producer][1])
+        assert not [p for p, _, files in os.walk(tmp) if files]
+        sess.shutdown()
+        assert not os.path.isdir(tmp)  # close() removes the temp dir
+    finally:
+        os.environ.pop("BIGSLICE_SHUFFLE", None)
+
+
+def test_spilled_output_survives_resize():
+    """Loss survivable by construction: a mesh resize salvages nothing
+    and loses nothing for a spilled boundary — its rows live in the
+    store, and the consumer re-reads them on the new mesh."""
+    keys, vals = _keyed(8000)
+    os.environ["BIGSLICE_SHUFFLE"] = "spill"
+    try:
+        from jax.sharding import Mesh
+
+        ex = MeshExecutor(_flat_mesh())
+        sess = Session(executor=ex)
+        res = sess.run(bs.Reduce(bs.Const(16, keys, vals), _add))
+        before = sorted(res.rows())
+        lost = ex.resize(Mesh(np.array(jax.devices()[:4]), ("shards",)))
+        # No spilled producer was marked lost by the resize.
+        assert not [t for t in lost if "~spill" in t.name.op]
+        assert sorted(res.rows()) == before
+        sess.shutdown()
+    finally:
+        os.environ.pop("BIGSLICE_SHUFFLE", None)
+
+
+# -- result cache TTL + LRU (ops/cache.py satellite) ----------------------
+
+
+@pytest.fixture
+def rc_policy():
+    from bigslice_tpu.ops import cache as cache_mod
+
+    cache_mod.reset_result_cache_policy()
+    cache_mod.reset_result_cache_counts()
+    yield cache_mod
+    cache_mod.reset_result_cache_policy()
+    cache_mod.reset_result_cache_counts()
+
+
+def test_result_cache_ttl_expiry(tmp_path, rc_policy):
+    import time
+
+    cache_mod = rc_policy
+    cache_mod.configure_result_cache(ttl_s=300.0, max_bytes=None)
+    keys, vals = _keyed(2000, nkeys=20)
+    sess = Session()
+
+    def run():
+        s = cache_mod.Cache(
+            bs.Reduce(bs.Const(4, keys, vals), _add),
+            str(tmp_path / "p"),
+        )
+        res = sess.run(s)
+        rows = sorted(map(tuple, res.rows()))
+        res.discard()
+        return rows
+
+    first = run()
+    assert cache_mod.result_cache_counts()["miss"] == 4
+    assert run() == first  # within TTL: served from cache
+    assert cache_mod.result_cache_counts()["hit"] == 4
+    cache_mod.configure_result_cache(ttl_s=0.05)
+    time.sleep(0.1)
+    assert run() == first  # expired → recomputed, same rows
+    counts = cache_mod.result_cache_counts()
+    assert counts["expired"] == 4 and counts["miss"] == 8
+    sess.shutdown()
+
+
+def test_result_cache_lru_byte_bound(tmp_path, rc_policy):
+    import glob
+
+    cache_mod = rc_policy
+    cache_mod.configure_result_cache(ttl_s=None, max_bytes=1)
+    keys, vals = _keyed(2000, nkeys=20)
+    sess = Session()
+    s = cache_mod.Cache(
+        bs.Reduce(bs.Const(4, keys, vals), _add), str(tmp_path / "q")
+    )
+    res = sess.run(s)
+    rows = sorted(map(tuple, res.rows()))
+    res.discard()
+    counts = cache_mod.result_cache_counts()
+    # 4 shards written; everything but the most recent evicted.
+    assert counts["evicted"] == 3, counts
+    assert len(glob.glob(str(tmp_path / "q-*"))) == 1
+    policy = cache_mod.result_cache_policy()
+    assert policy["max_bytes"] == 1 and policy["tracked_files"] == 1
+    # A rerun recomputes the evicted shards and still answers right.
+    s2 = cache_mod.CachePartial(
+        bs.Reduce(bs.Const(4, keys, vals), _add), str(tmp_path / "q")
+    )
+    res2 = sess.run(s2)
+    assert sorted(map(tuple, res2.rows())) == rows
+    sess.shutdown()
