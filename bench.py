@@ -499,6 +499,206 @@ def reduce_wave_spill_bench(n_rows: int, iters: int = 3):
     }
 
 
+# ------------------------------------------------- reduce-wave-adaptive
+
+def _bump(k, v):
+    """Row-local consumer map for the adaptive skew A/B (module-level:
+    stable fn identity across legs, like ``_add``)."""
+    return (k, v + 0)
+
+
+def reduce_wave_adaptive_bench(n_rows: int, slow_s: float = 0.5,
+                               slow_count: int = 2):
+    """The adaptive-execution A/B (exec/adaptive.py), two phases:
+
+    **Speculation under slow-host chaos (ASSERTED)** — the same keyed
+    Reduce runs with ``BIGSLICE_ADAPTIVE=off`` and ``=all`` under an
+    identical fixed-seed fault plan that makes the first
+    ``slow_count`` store reads sleep ``slow_s``–``2*slow_s`` seconds
+    (a deterministic slow host, utils/faultinject.py ``~slow``).
+    Results must be value-identical; with ``all`` the straggler
+    watcher must race duplicates (launched >= 1, won >= 1) and both
+    the p99 completed-task duration AND the e2e wall-clock must come
+    in BELOW the ``off`` leg — the acceptance criteria, asserted not
+    printed. The phase runs a small fixed corpus so the injected
+    sleeps, not per-row work, dominate the tail.
+
+    **Hot-shard splitting (parity ASSERTED)** — a skewed-key waved
+    pipeline (one hub partition carrying most rows) runs on the mesh
+    executor ``off`` vs ``all``: the flagged consumer wave must split
+    into row-slices (skew_splits >= 1) and re-merge value-identical.
+    Timing is reported, not asserted: on a CPU mesh the split's win is
+    tail-latency on real multi-host fleets, not local throughput.
+
+    Returns the dict the run_mode entry emits."""
+    import os
+
+    import bigslice_tpu as bs
+    from bigslice_tpu.exec.local import LocalExecutor
+    from bigslice_tpu.exec.meshexec import MeshExecutor
+    from bigslice_tpu.exec.session import Session
+    from bigslice_tpu.utils import faultinject
+    from bigslice_tpu.utils.telemetry import quantile
+
+    env_keys = ("BIGSLICE_ADAPTIVE", "BIGSLICE_ADAPTIVE_POLL_S",
+                "BIGSLICE_CHAOS_SLOW_S")
+    prev = {k: os.environ.get(k) for k in env_keys}
+
+    def set_env(mode):
+        os.environ["BIGSLICE_ADAPTIVE"] = mode
+        os.environ["BIGSLICE_ADAPTIVE_POLL_S"] = "0.005"
+        os.environ["BIGSLICE_CHAOS_SLOW_S"] = str(slow_s)
+
+    def restore_env():
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    # -- phase 1: speculative duplicates vs a deterministic slow host --
+    spec_rows = 16000
+    rng = np.random.RandomState(3)
+    keys1 = rng.randint(0, 199, spec_rows).astype(np.int32)
+    vals1 = np.ones(spec_rows, np.int32)
+    plan_spec = f"11:store.read=1.0x{slow_count}~slow"
+
+    def spec_leg(mode):
+        set_env(mode)
+        sess = None
+        try:
+            sess = Session(executor=LocalExecutor(procs=4))
+            # Bench-scale straggler thresholds: flag a RUNNING task
+            # 1.5x beyond 2 finished siblings (the knobs exist for
+            # exactly this — production defaults assume minutes-long
+            # tasks).
+            sess.telemetry.straggler_factor = 1.5
+            sess.telemetry.straggler_min_secs = 0.05
+            sess.telemetry.straggler_min_siblings = 2
+            r = bs.Reduce(bs.Const(8, keys1, vals1), _add)
+            res = sess.run(r)          # chaos-free warm: page-in, no
+            rows = sorted(res.rows())  # fault budget spent
+            res.discard()
+            faultinject.install(faultinject.parse_plan(plan_spec))
+            try:
+                t0 = time.perf_counter()
+                res = sess.run(bs.Reduce(bs.Const(8, keys1, vals1),
+                                         _add))
+                rows = sorted(res.rows())
+                wall = time.perf_counter() - t0
+                res.discard()
+            finally:
+                faultinject.clear()
+            spec = {"launched": 0, "won": 0, "wasted": 0}
+            if sess.adaptive is not None:
+                st = sess.adaptive.stats
+                # Attribution settles when the losing original
+                # finishes its injected sleep; wait for it.
+                deadline = time.monotonic() + 10.0
+                while time.monotonic() < deadline:
+                    if (st.speculative_won + st.speculative_wasted
+                            >= st.speculative_launched
+                            and st.speculative_launched >= 1):
+                        break
+                    time.sleep(0.02)
+                spec = {"launched": st.speculative_launched,
+                        "won": st.speculative_won,
+                        "wasted": st.speculative_wasted}
+            ds = sess.telemetry.task_durations()
+            p99 = quantile(ds, 0.99) if ds else 0.0
+            return rows, wall, p99, spec
+        finally:
+            if sess is not None:
+                sess.shutdown()
+            restore_env()
+
+    off_rows, off_wall, off_p99, _ = spec_leg("off")
+    all_rows, all_wall, all_p99, spec = spec_leg("all")
+    if all_rows != off_rows:
+        raise RuntimeError(
+            "adaptive=all result differs from adaptive=off"
+        )
+    if spec["launched"] < 1 or spec["won"] < 1:
+        raise RuntimeError(
+            f"speculation never engaged/won under slow chaos: {spec}"
+        )
+    if not (all_p99 < off_p99 and all_wall < off_wall):
+        raise RuntimeError(
+            f"adaptive leg did not beat the tail: p99 {all_p99:.3f}s "
+            f"vs {off_p99:.3f}s, wall {all_wall:.3f}s vs "
+            f"{off_wall:.3f}s"
+        )
+    note(f"reduce_wave_adaptive spec: off wall {off_wall:.2f}s "
+         f"p99 {off_p99:.2f}s; all wall {all_wall:.2f}s "
+         f"p99 {all_p99:.2f}s ({spec['launched']} raced, "
+         f"{spec['won']} won, {spec['wasted']} wasted), "
+         f"value-identical")
+
+    # -- phase 2: hot-shard splitting on the mesh, parity enforced ----
+    rng = np.random.RandomState(7)
+    keys2 = np.where(rng.rand(n_rows) < 0.6, 0,
+                     rng.randint(0, 1 << 10, n_rows)).astype(np.int32)
+    vals2 = np.ones(n_rows, np.int32)
+
+    def skew_leg(mode):
+        set_env(mode)
+        sess = None
+        try:
+            sess = Session(executor=MeshExecutor(_mesh()))
+
+            def run_once():
+                r = bs.Reduce(
+                    bs.Map(bs.Reshuffle(bs.Const(8, keys2, vals2)),
+                           _bump),
+                    _add,
+                )
+                res = sess.run(r)
+                out = sorted(map(tuple, res.rows()))
+                res.discard()
+                return out
+
+            run_once()  # warm compile caches
+            t0 = time.perf_counter()
+            rows = run_once()
+            wall = time.perf_counter() - t0
+            splits = (sess.adaptive.stats.skew_splits
+                      if sess.adaptive is not None else 0)
+            if sess.executor.device_group_count() == 0:
+                raise RuntimeError(
+                    "adaptive skew bench never engaged the device path"
+                )
+            return rows, wall, splits
+        finally:
+            if sess is not None:
+                sess.shutdown()
+            restore_env()
+
+    base_rows, base_wall, _ = skew_leg("off")
+    split_rows, split_wall, splits = skew_leg("all")
+    if split_rows != base_rows:
+        raise RuntimeError(
+            "skew-split result differs from the unsplit wave"
+        )
+    if splits < 1:
+        raise RuntimeError("hot-shard split never engaged")
+    note(f"reduce_wave_adaptive skew: {splits} hot-wave splits, "
+         f"off {n_rows/base_wall:,.0f} rows/s, all "
+         f"{n_rows/split_wall:,.0f} rows/s, value-identical")
+
+    return {
+        "off_rps": spec_rows / off_wall,
+        "all_rps": spec_rows / all_wall,
+        "off_wall_s": off_wall,
+        "all_wall_s": all_wall,
+        "off_p99_s": off_p99,
+        "all_p99_s": all_p99,
+        "speculative": spec,
+        "skew_splits": splits,
+        "skew_off_rps": n_rows / base_wall,
+        "skew_all_rps": n_rows / split_wall,
+    }
+
+
 # ------------------------------------------------------------- staging
 
 def staging_bench(n_rows: int, dim: int = 16, iters: int = 7):
@@ -1480,6 +1680,30 @@ def run_mode(mode: str, size, fallback: bool) -> None:
              partitions=r["partitions"],
              map_waves=r["map_waves"],
              sub_waves=r["sub_waves"])
+    elif mode == "reduce-wave-adaptive":
+        # The telemetry→action loop A/B (see reduce_wave_adaptive_
+        # bench): vs_baseline is the SAME run with BIGSLICE_ADAPTIVE
+        # unset under the identical fixed-seed slow-host fault plan —
+        # the number that judges what closing the loop buys when the
+        # fleet misbehaves. Value parity, speculation engagement, and
+        # the p99/wall-clock win are asserted inside the bench; the
+        # emitted line carries the evidence the CI smoke re-checks.
+        n_rows = size or (1 << 18 if fallback else 1 << 20)
+        r = reduce_wave_adaptive_bench(n_rows)
+        emit("reduce_wave_adaptive_e2e_rows_per_sec", r["all_rps"],
+             "rows/sec", r["off_rps"],
+             parity="value-identical",
+             off_wall_s=round(r["off_wall_s"], 3),
+             all_wall_s=round(r["all_wall_s"], 3),
+             off_p99_task_s=round(r["off_p99_s"], 4),
+             all_p99_task_s=round(r["all_p99_s"], 4),
+             p99_improvement=round(
+                 r["off_p99_s"] / r["all_p99_s"], 2)
+             if r["all_p99_s"] else None,
+             speculative=r["speculative"],
+             skew_splits=r["skew_splits"],
+             skew_off_rows_per_sec=round(r["skew_off_rps"], 3),
+             skew_all_rows_per_sec=round(r["skew_all_rps"], 3))
     elif mode == "reduce-wave-staged":
         # The serving shape: waved Reduce whose shards stage from
         # encoded stream files (read → decode → assemble → upload is
@@ -1648,7 +1872,7 @@ def main():
     args = sys.argv[1:]
     known = ("reduce", "reduce-sort", "reduce-nohash", "reduce-dense",
              "reduce-wave", "reduce-wave-2d", "reduce-wave-staged",
-             "reduce-wave-spill",
+             "reduce-wave-spill", "reduce-wave-adaptive",
              "staging", "serve-qps",
              "reduce-kernel", "join", "join-dense",
              "join-kernel", "wordcount", "sortshuffle", "cogroup",

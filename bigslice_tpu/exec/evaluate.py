@@ -77,8 +77,27 @@ def evaluate(executor, roots: Sequence[Task], monitor=None) -> None:
     ``executor`` implements ``submit(task)`` (async: eventually moves the
     task from WAITING to a terminal state). ``monitor``, if given, receives
     ``(task, state)`` transition callbacks (status displays, tracing).
+
+    When the executor carries an adaptive planner (exec/adaptive.py,
+    attached by the Session under BIGSLICE_ADAPTIVE), the spec policy's
+    straggler watcher runs for the duration of this evaluation: it
+    polls the hub's live-straggler flags and races duplicates of
+    flagged tasks through ``executor.speculate``. With the knob unset
+    ``executor.adaptive`` is None and this path adds nothing.
     """
-    _Evaluation(executor, roots, monitor).run()
+    ev = _Evaluation(executor, roots, monitor)
+    planner = getattr(executor, "adaptive", None)
+    watcher = None
+    if planner is not None:
+        try:
+            watcher = planner.watch(ev.tasks, executor)
+        except Exception:
+            watcher = None
+    try:
+        ev.run()
+    finally:
+        if watcher is not None:
+            watcher.stop()
 
 
 class _Evaluation:

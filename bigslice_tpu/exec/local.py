@@ -86,6 +86,17 @@ class _Limiter:
             self._cond.wait_for(lambda: self._avail >= n)
             self._avail -= n
 
+    def try_acquire(self, n: int) -> bool:
+        """Non-blocking acquire: permits only if FREE right now (the
+        speculative-duplicate contract — racing a straggler must never
+        steal capacity from first-run tasks)."""
+        n = min(n, self.capacity)
+        with self._cond:
+            if self._avail < n:
+                return False
+            self._avail -= n
+            return True
+
     def release(self, n: int) -> None:
         n = min(n, self.capacity)
         with self._cond:
@@ -135,6 +146,10 @@ class LocalExecutor:
         self._mc_contrib: dict = {}        # ck -> {shard: [parts]}
         self._mc_committed: dict = {}      # (ck, p) -> Frame
         self._mc_keys_committed: set = set()
+        # Speculative straggler duplicates (exec/adaptive.py): at most
+        # one racing duplicate per task name in flight.
+        self._spec_lock = threading.Lock()
+        self._spec_inflight: set = set()
 
     def start(self, session) -> None:
         self.session = session
@@ -142,6 +157,10 @@ class LocalExecutor:
     # -- evaluation-facing API (Executor iface, exec/eval.go:42-71) -------
 
     def submit(self, task: Task) -> None:
+        # Stamp the tier: only tasks that run on THIS pool are
+        # speculation-eligible (a mesh gang member or an owner-routed
+        # distributed host task has no local duplicate to race).
+        task._local_tier = True
         self._queue.put(task)
         with self._pool_lock:
             if self._idle == 0 and self._workers < self.procs:
@@ -295,6 +314,67 @@ class LocalExecutor:
         finally:
             self._limiter.release(permits)
 
+    # -- speculative straggler duplicates (exec/adaptive.py) --------------
+
+    def speculate(self, task: Task, on_outcome=None) -> bool:
+        """Race a duplicate of a RUNNING task on a FREE permit; returns
+        True when the duplicate launched. First completion wins by the
+        task state machine's atomic RUNNING→OK transition — whichever
+        side loses finds the CAS False. Deterministic task bodies make
+        the duplicate's store puts idempotent (same frames, atomic
+        rebind/replace), so the losing result is harmless.
+
+        Never speculated: tasks not submitted to this pool
+        (``_local_tier`` unset — mesh gang members, owner-routed
+        distributed host tasks), exclusive tasks (a duplicate would
+        need the whole capacity the original already holds), and
+        machine-combined tasks (the shared combiner buffer's
+        post-commit contribution check makes a late duplicate fatal by
+        design). ``on_outcome`` hears ``"won"``/``"wasted"`` when the
+        race settles (attribution for exec/adaptive.py)."""
+        if not getattr(task, "_local_tier", False):
+            return False
+        if task.exclusive or task.partitioner.combine_key:
+            return False
+        if task.state != TaskState.RUNNING:
+            return False
+        with self._spec_lock:
+            if task.name in self._spec_inflight:
+                return False
+            self._spec_inflight.add(task.name)
+        if not self._limiter.try_acquire(task.procs):
+            with self._spec_lock:
+                self._spec_inflight.discard(task.name)
+            return False
+        threading.Thread(
+            target=self._run_speculative, args=(task, on_outcome),
+            daemon=True, name=f"speculate-{task.name.op}",
+        ).start()
+        return True
+
+    def _run_speculative(self, task: Task, on_outcome) -> None:
+        won = False
+        try:
+            with metrics_mod.scope_context(task.scope):
+                self._execute(task, record_telemetry=False)
+            won = task.transition_if(TaskState.RUNNING, TaskState.OK)
+        except Exception:  # noqa: BLE001 — the original still runs;
+            pass           # its own error ladder judges the task.
+        finally:
+            self._limiter.release(task.procs)
+            with self._spec_lock:
+                self._spec_inflight.discard(task.name)
+        if won:
+            # The duplicate's OK is authoritative; clear the loss debt
+            # exactly as mark_ok would have.
+            with task._lock:
+                task.consecutive_lost = 0
+        if on_outcome is not None:
+            try:
+                on_outcome("won" if won else "wasted")
+            except Exception:
+                pass
+
     def _record_shuffle(self, task: Task, rows: List[int],
                         nbytes: List[int]) -> None:
         """Report this producer's per-partition routed sizes to the
@@ -310,10 +390,12 @@ class LocalExecutor:
         except Exception:
             pass
 
-    def _execute(self, task: Task) -> None:
+    def _execute(self, task: Task,
+                 record_telemetry: bool = True) -> None:
         spillers: List[Optional[object]] = []
         try:
-            self._execute_inner(task, spillers)
+            self._execute_inner(task, spillers,
+                                record_telemetry=record_telemetry)
         finally:
             # Spill dirs must never outlive the task (error paths
             # included); cleanup is idempotent.
@@ -321,7 +403,8 @@ class LocalExecutor:
                 if sp is not None:
                     sp.cleanup()
 
-    def _execute_inner(self, task: Task, spillers) -> None:
+    def _execute_inner(self, task: Task, spillers,
+                       record_telemetry: bool = True) -> None:
         factories = [self._dep_factory(d) for d in task.deps]
         reader = task.do(factories)
         nparts = task.num_partition
@@ -381,7 +464,10 @@ class LocalExecutor:
                         spillers[p].spill(iter(parts[p]))
                         parts[p] = []
                         pending_rows[p] = 0
-        if nparts > 1:
+        if nparts > 1 and record_telemetry:
+            # Speculative duplicates skip this: the original's run
+            # already accumulated the routed sizes, and a second
+            # contribution would double-count the skew vector.
             self._record_shuffle(task, routed_rows, routed_bytes)
         comb = task.combiner
         ck = task.partitioner.combine_key

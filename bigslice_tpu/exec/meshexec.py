@@ -620,6 +620,12 @@ class MeshExecutor:
             env = os.environ.get("BIGSLICE_DEVICE_BUDGET_BYTES")
             device_budget_bytes = int(env) if env else None
         self.device_budget_bytes = device_budget_bytes
+        # Adaptive planner (exec/adaptive.py), attached by the Session
+        # when BIGSLICE_ADAPTIVE engages at least one policy. None =
+        # the chicken bit: every consulting site below holds
+        # ``self.adaptive is not None`` before touching it, so with the
+        # knob unset no adaptive code path executes at all.
+        self.adaptive = None
         # op base -> K of the last split run (observability/tests).
         self.split_runs: Dict[str, int] = {}
         # op base -> chosen attend lowering ("ring"/"ulysses"),
@@ -955,6 +961,16 @@ class MeshExecutor:
                 and self._hostdist.submit(task)):
             return  # non-owner: resolves via the exchange poller
         self.local.submit(task)
+
+    def speculate(self, task: Task, on_outcome=None) -> bool:
+        """Adaptive straggler speculation (exec/adaptive.py): race a
+        duplicate of a RUNNING task. Delegates to the host tier, whose
+        ``_local_tier`` stamp restricts the race to tasks this
+        process's pool actually runs — an SPMD gang member has no
+        independent duplicate to race (the whole gang IS the unit of
+        dispatch), and an owner-routed distributed host task resolves
+        on its owner."""
+        return self.local.speculate(task, on_outcome=on_outcome)
 
     def release_run_outputs(self, roots: List[Task]) -> None:
         """Post-run KV hygiene for distributed host tasks (see
@@ -1460,10 +1476,13 @@ class MeshExecutor:
                 # collective that can never complete.
                 self._keepalive.check()
             if faultinject.ENABLED:
-                # Chaos seam on SPMD dispatch: 'infra' rides the
-                # probation → host-tier resubmit ladder below;
-                # 'hostloss' rides the gang-loss → elastic ladder.
-                fault = faultinject.fire("mesh.dispatch")
+                # Chaos seam on SPMD dispatch: 'slow' sleeps a seeded
+                # deterministic delay (a reproducible straggler host)
+                # and is absorbed; 'infra' rides the probation →
+                # host-tier resubmit ladder below; 'hostloss' rides the
+                # gang-loss → elastic ladder.
+                fault = faultinject.absorb_slow(
+                    faultinject.fire("mesh.dispatch"))
                 if fault is not None:
                     raise faultinject.injected_error(fault)
             self._execute_group(key, tasks)
@@ -1671,16 +1690,30 @@ class MeshExecutor:
             self.nmesh,
         )
         if mode == "auto" and ineligible is None and budget is not None:
-            t0 = time.perf_counter()
-            stats0: dict = {}
-            inputs0 = self._group_inputs(wave_tasks[0], 0, stats=stats0)
-            dur = time.perf_counter() - t0
-            self._telemetry_staging(task0, 0, dur, dur, stats0)
-            wave_bytes = sum(
-                int(getattr(a, "nbytes", 0) or 0)
-                for i in inputs0 for a in list(i[0]) + [i[1]]
-            )
-            est = wave_bytes * len(wave_tasks)
+            # Measured cost first: when the device plane has this op's
+            # compiled cost analysis (bytes accessed per wave program —
+            # cost-driven shaping's first consumer), price the boundary
+            # from it; the staged-wave-0-bytes heuristic is the
+            # fallback for ops that never compiled under telemetry.
+            dev = self._device_telemetry()
+            if dev is not None:
+                est = dev.cost_bytes(_op_base(task0.name.op))
+                if est:
+                    est = int(est) * len(wave_tasks)
+                else:
+                    est = None
+            if est is None:
+                t0 = time.perf_counter()
+                stats0: dict = {}
+                inputs0 = self._group_inputs(wave_tasks[0], 0,
+                                             stats=stats0)
+                dur = time.perf_counter() - t0
+                self._telemetry_staging(task0, 0, dur, dur, stats0)
+                wave_bytes = sum(
+                    int(getattr(a, "nbytes", 0) or 0)
+                    for i in inputs0 for a in list(i[0]) + [i[1]]
+                )
+                est = wave_bytes * len(wave_tasks)
         plan = shuffleplan_mod.choose(mode, est, budget, ineligible)
         return plan, inputs0
 
@@ -1997,21 +2030,71 @@ class MeshExecutor:
         except Exception:
             pass
 
+    def _wave_budget(self, task0: Task):
+        """The per-device wave working-set budget the split and
+        prefetch gates hold estimates against: the static
+        ``device_budget_bytes`` knob when set (an explicit knob always
+        wins), else the adaptive cost policy's MEASURED budget —
+        hbm_budget() × headroom (exec/adaptive.py). Returns
+        ``(budget, adaptive)``; ``adaptive`` marks a measured budget
+        so the shaping it drives can be attributed."""
+        if self.device_budget_bytes:
+            return self.device_budget_bytes, False
+        planner = self.adaptive
+        if planner is not None:
+            b = planner.cost_wave_budget(_op_base(task0.name.op),
+                                         inv=task0.name.inv_index)
+            if b:
+                return b, True
+        return None, False
+
+    def _adaptive_skew_split(self, tasks: List[Task], wave: int,
+                             inputs):
+        """The skew policy's wave-boundary consult (exec/adaptive.py):
+        a skew-flagged producer op in this wave's deps → run the wave
+        as K row-slices through _execute_wave_sliced (bit-identical by
+        the wave-merge contract). None = run unsplit. Preconditions
+        mirror the budget split's: single non-subid input, a row-local
+        chain ending in shuffle."""
+        planner = self.adaptive
+        task0 = tasks[0]
+        if (planner is None
+                or task0.num_partition <= 1
+                or len(inputs) != 1 or inputs[0][3]
+                or not self._splittable_chain(task0)):
+            return None
+        K = planner.skew_split_k(
+            [d.tasks[0].name.op for d in task0.deps], inputs[0][2],
+            inv=task0.name.inv_index,
+        )
+        if K <= 1:
+            return None
+        return self._execute_wave_sliced(tasks, wave, inputs, K)
+
     def _effective_prefetch_depth(self, task0: Task, inputs,
                                   nwaves: int) -> int:
         """The pipeline depth this group actually runs at: the
         configured knob, clipped so (1 + depth) concurrent wave working
-        sets stay inside device_budget_bytes — prefetch must never bust
-        the budget that wave splitting (_try_execute_wave_split)
-        exists to enforce."""
+        sets stay inside the wave budget (static knob, else the
+        adaptive cost policy's measured one) — prefetch must never
+        bust the budget that wave splitting
+        (_try_execute_wave_split) exists to enforce."""
         depth = min(self.prefetch_depth, nwaves - 1)
         if depth <= 0:
             return 0
-        budget = self.device_budget_bytes
+        budget, adaptive = self._wave_budget(task0)
         if budget:
             est = self._wave_bytes_estimate(task0, inputs)
+            depth0 = depth
             while depth > 0 and (1 + depth) * est > budget:
                 depth -= 1
+            if adaptive and depth < depth0:
+                self.adaptive.note_cost_action(
+                    "prefetch_clip", _op_base(task0.name.op),
+                    inv=task0.name.inv_index,
+                    depth=depth, configured=depth0,
+                    budget_bytes=budget,
+                )
         return depth
 
     def _execute_waves(self, task0: Task,
@@ -2234,7 +2317,7 @@ class MeshExecutor:
         entry for _settle_wave."""
         task0 = tasks[0]
         self._maybe_auto_dense(task0, inputs, wave)
-        budget = self.device_budget_bytes
+        budget, adaptive_budget = self._wave_budget(task0)
         if (budget
                 and task0.num_partition > 1
                 and len(inputs) == 1 and not inputs[0][3]
@@ -2244,7 +2327,18 @@ class MeshExecutor:
                 tasks, wave, inputs, budget
             )
             if split is not None:
+                if adaptive_budget:
+                    self.adaptive.note_cost_action(
+                        "wave_split", _op_base(task0.name.op),
+                        inv=task0.name.inv_index,
+                        k=self.split_runs.get(
+                            _op_base(task0.name.op)),
+                        budget_bytes=budget,
+                    )
                 return (None, None, None, split)
+        split = self._adaptive_skew_split(tasks, wave, inputs)
+        if split is not None:
+            return (None, None, None, split)
         return (tasks, wave, inputs,
                 self._dispatch_wave_on(tasks, wave, inputs))
 
@@ -2272,7 +2366,7 @@ class MeshExecutor:
         # atomic against concurrent evaluations on this executor.
         with self._wave_mutex:
             self._maybe_auto_dense(task0, inputs, wave)
-            budget = self.device_budget_bytes
+            budget, adaptive_budget = self._wave_budget(task0)
             out = None
             if (budget
                     and task0.num_partition > 1
@@ -2283,6 +2377,16 @@ class MeshExecutor:
                 out = self._try_execute_wave_split(
                     tasks, wave, inputs, budget
                 )
+                if out is not None and adaptive_budget:
+                    self.adaptive.note_cost_action(
+                        "wave_split", _op_base(task0.name.op),
+                        inv=task0.name.inv_index,
+                        k=self.split_runs.get(
+                            _op_base(task0.name.op)),
+                        budget_bytes=budget,
+                    )
+            if out is None:
+                out = self._adaptive_skew_split(tasks, wave, inputs)
             if out is None:
                 out = self._execute_wave_on(
                     tasks, wave, inputs,
@@ -2333,7 +2437,7 @@ class MeshExecutor:
         the shape doesn't split cleanly (power-of-two capacities make
         that the rare case)."""
         task0 = tasks[0]
-        cols, counts, cap, _sub, _owned = inputs[0]
+        cap = inputs[0][2]
         est = self._wave_bytes_estimate(task0, inputs)
         want = (est + budget - 1) // budget
         K = 1
@@ -2344,6 +2448,18 @@ class MeshExecutor:
             K >>= 1  # only exact row-slices keep the prefix contract
         if K <= 1:
             return None
+        return self._execute_wave_sliced(tasks, wave, inputs, K)
+
+    def _execute_wave_sliced(self, tasks: List[Task], wave: int,
+                             inputs, K: int) -> DeviceGroupOutput:
+        """Run one wave as K exact row-slices of its single dep (K must
+        divide the capacity), merging the partitioned sub-outputs as
+        multiple producer contributions — the shared substrate of the
+        budget split above and the adaptive skew split
+        (exec/adaptive.py), both bit-identical to the unsplit wave by
+        the wave-merge contract."""
+        task0 = tasks[0]
+        cols, counts, cap, _sub, _owned = inputs[0]
         B = cap // K
         prog = self._slice_wave_program(
             tuple(str(np.dtype(c.dtype)) for c in cols), cap, B

@@ -358,6 +358,21 @@ class Session:
 
         self._inv_index = func_mod._invocation_counter
         self._gate = _InvocationGate()
+        # Adaptive execution (exec/adaptive.py): BIGSLICE_ADAPTIVE
+        # engages the telemetry→action loop — hot-shard skew splitting,
+        # speculative straggler duplicates, cost-driven wave/prefetch
+        # shaping. Unset = planner_from_env returns None and NOTHING
+        # here attaches: the chicken-bit contract (bit-identical legacy
+        # behavior, zero bigslice_adaptive_* samples).
+        self.adaptive = None
+        from bigslice_tpu.exec import adaptive as adaptive_mod
+
+        planner = adaptive_mod.planner_from_env(self.telemetry)
+        if planner is not None:
+            self.adaptive = planner
+            if self.telemetry is not None:
+                self.telemetry.adaptive = planner.stats
+            executor.adaptive = planner
         executor.start(self)
         self._event("bigslice:sessionStart", executor=executor.name)
 

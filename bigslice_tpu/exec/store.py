@@ -97,7 +97,10 @@ class MemoryStore(Store):
 
     def read(self, name, partition):
         if faultinject.ENABLED:
-            f = faultinject.fire("store.read")
+            # 'slow' faults sleep their deterministic delay and are
+            # absorbed here (a reproducible slow disk); anything else
+            # falls through to the loss ladder.
+            f = faultinject.absorb_slow(faultinject.fire("store.read"))
             if f is not None:
                 # The committed entry vanishes, as if the machine
                 # holding it died between produce and serve.
@@ -270,7 +273,9 @@ class FileStore(Store):
     def _read_direct(self, name, partition):
         path = self._path(name, partition)
         if faultinject.ENABLED:
-            f = faultinject.fire("store.read")
+            # 'slow' faults sleep and are absorbed (slow disk); only
+            # loss faults proceed to delete the committed file.
+            f = faultinject.absorb_slow(faultinject.fire("store.read"))
             if f is not None:
                 # The committed file vanishes, as if the machine
                 # holding it died between produce and serve.

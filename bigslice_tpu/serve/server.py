@@ -266,6 +266,15 @@ class ServeServer(DebugServer):
         # (decisions must be atomic — a race could admit past the
         # bound); a Condition hands freed slots to waiters FIFO-ish.
         self._adm = threading.Condition()
+        # Cost-keyed admission (exec/adaptive.py "cost" policy): per-
+        # pipeline measured invocation cost (cost_analysis() bytes-
+        # accessed, captured on the first — compiling — invocation) and
+        # the sum currently admitted against the device budget. Both
+        # stay zero unless the Session carries an adaptive planner with
+        # the cost policy engaged (BIGSLICE_ADAPTIVE), so the knob-off
+        # path is untouched.
+        self._pipe_cost: Dict[str, int] = {}
+        self._cost_inflight = 0
         self._started = time.time()
         super().__init__(session, port)
         self._hook_session(session)
@@ -389,7 +398,43 @@ class ServeServer(DebugServer):
             "queue_depth": self.queue_depth,
             "tenant_quota": self.tenant_quota,
         }
+        if self._cost_planner() is not None:
+            with self._adm:
+                doc["admission"]["cost"] = {
+                    "budget_bytes": self._cost_budget(),
+                    "inflight_bytes": self._cost_inflight,
+                    "predicted_bytes": dict(self._pipe_cost),
+                }
         return doc
+
+    def _cost_planner(self):
+        """The Session's adaptive planner when its cost policy is
+        engaged, else None (the chicken bit for cost-keyed admission —
+        BIGSLICE_ADAPTIVE unset means this returns None and every
+        cost-admission branch below is dead)."""
+        planner = getattr(self.session, "adaptive", None)
+        if planner is None or "cost" not in getattr(
+                planner, "policies", ()):
+            return None
+        return planner
+
+    def _cost_budget(self) -> int:
+        """Admission byte budget: BIGSLICE_SERVE_COST_BUDGET_BYTES if
+        set, else the measured per-device HBM budget (0 = no gate)."""
+        raw = os.environ.get("BIGSLICE_SERVE_COST_BUDGET_BYTES")
+        if raw:
+            try:
+                return max(0, int(raw))
+            except ValueError:
+                pass
+        hub = getattr(self.session, "telemetry", None)
+        dev = getattr(hub, "device", None)
+        if dev is not None:
+            try:
+                return int(dev.hbm_budget() or 0)
+            except Exception:
+                return 0
+        return 0
 
     def invoke_request(self, req: dict):
         """The full admission + execution path for one invocation
@@ -414,6 +459,8 @@ class ServeServer(DebugServer):
             }
 
         # -- admission (atomic under the condition's lock) ------------
+        planner = self._cost_planner()
+        predicted = 0
         with self._adm:
             if self._closing:
                 self.stats.record(tenant, "rejected_closing")
@@ -437,6 +484,33 @@ class ServeServer(DebugServer):
                              f"{self.queue_depth} queued)",
                     "retry": True,
                 }
+            if planner is not None:
+                # Cost gate: shed when this pipeline's predicted bytes-
+                # accessed would push the admitted total past the
+                # budget. The _cost_inflight > 0 guard means an idle
+                # server always admits — an over-budget pipeline still
+                # runs alone, it just can't stack.
+                predicted = int(self._pipe_cost.get(name) or 0)
+                budget = self._cost_budget()
+                if (budget and predicted and self._cost_inflight > 0
+                        and self._cost_inflight + predicted > budget):
+                    planner.stats.record(
+                        "cost", "serve_shed", pipeline=name,
+                        predicted_bytes=predicted,
+                        inflight_bytes=self._cost_inflight,
+                        budget_bytes=budget)
+                    self.stats.record(tenant, "rejected_cost")
+                    return 503, {
+                        "error": f"pipeline {name!r} predicted cost "
+                                 f"{predicted}B would exceed the "
+                                 f"admission budget ({budget}B, "
+                                 f"{self._cost_inflight}B in flight)",
+                        "retry": True,
+                    }
+                if predicted:
+                    planner.note_cost_action(
+                        "serve_admit", name,
+                        predicted_bytes=predicted)
             self.stats.adjust_inflight(tenant, +1)
             if self.stats.active < self.slots:
                 self.stats.active += 1
@@ -451,10 +525,15 @@ class ServeServer(DebugServer):
                         return 503, {"error": "shutting down"}
                 self.stats.queued -= 1
                 self.stats.active += 1
+            self._cost_inflight += predicted
+            sole = self.stats.active == 1
 
         t0 = time.perf_counter()
+        b0 = self._cost_probe() if planner is not None else 0
         try:
             doc = self._run(pipe, args, want_rows, max_rows)
+            if planner is not None:
+                self._cost_measure(planner, name, b0, sole)
         except Exception as e:  # noqa: BLE001 — serve errors as JSON
             latency = time.perf_counter() - t0
             self.stats.record(tenant, "error", latency)
@@ -466,6 +545,7 @@ class ServeServer(DebugServer):
         finally:
             with self._adm:
                 self.stats.active -= 1
+                self._cost_inflight -= predicted
                 self.stats.adjust_inflight(tenant, -1)
                 self._adm.notify_all()
         latency = time.perf_counter() - t0
@@ -477,6 +557,37 @@ class ServeServer(DebugServer):
             "latency_s": round(latency, 6),
         })
         return 200, doc
+
+    def _cost_probe(self) -> int:
+        """Session-total compiled bytes-accessed right now (the
+        measurement baseline for one invocation's cost delta)."""
+        hub = getattr(self.session, "telemetry", None)
+        dev = getattr(hub, "device", None)
+        if dev is None:
+            return 0
+        try:
+            return int(dev.total_cost_bytes())
+        except Exception:
+            return 0
+
+    def _cost_measure(self, planner, name: str, b0: int,
+                      sole: bool) -> None:
+        """Fold one invocation's measured compile-cost delta into the
+        pipeline's prediction. Only sole-in-flight invocations update
+        it (a concurrent invocation's compiles would pollute the
+        delta); cost accrues at compile time, so the first invocation
+        of a pipeline measures it and cached repeats leave the
+        prediction stable."""
+        delta = self._cost_probe() - b0
+        if not sole or delta <= 0:
+            return
+        with self._adm:
+            prev = int(self._pipe_cost.get(name) or 0)
+            if delta > prev:
+                self._pipe_cost[name] = int(delta)
+        if delta > prev:
+            planner.stats.record("cost", "serve_measured",
+                                 pipeline=name, cost_bytes=int(delta))
 
     def _cache_prefix(self, pipe: Pipeline, args) -> str:
         digest = hashlib.sha1(repr(tuple(args)).encode()).hexdigest()

@@ -131,6 +131,7 @@ def _print_inv(out: List[str], inv, summary: dict, tasks: List[dict],
                   telem.get("donation", ()))
     _print_exchange(out, inv, telem.get("exchange", ()))
     _print_spill(out, inv, telem.get("spill", ()))
+    _print_adaptive(out, inv, telem.get("adaptive", ()))
     out.append("")
 
 
@@ -394,6 +395,30 @@ def _print_spill(out: List[str], inv, events):
         )
 
 
+def _print_adaptive(out: List[str], inv, events):
+    """Adaptive-loop decisions from bigslice:adaptive instants
+    (exec/adaptive.py): which policy fired, what it did, and the
+    measured evidence it acted on — absent entirely when
+    BIGSLICE_ADAPTIVE is off (the planner never emits)."""
+    if not events:
+        return
+    out.append(f"# inv{inv}:adaptive (telemetry-driven decisions)")
+    out.append(f"  {'policy':<6} {'action':<14} {'target':<28} "
+               f"evidence")
+    for ev in events[-24:]:
+        a = dict(ev.get("args", {}))
+        policy = str(a.pop("policy", "?"))
+        action = str(a.pop("action", "?"))
+        target = str(a.pop("op", None) or a.pop("task", None)
+                     or a.pop("pipeline", None) or "-")
+        a.pop("inv", None)
+        evidence = " ".join(
+            f"{k}={a[k]}" for k in sorted(a)
+        ) or "-"
+        out.append(f"  {policy:<6} {action:<14} {target[:28]:<28} "
+                   f"{evidence}")
+
+
 def analyze(path: str) -> str:
     with open(path) as fp:
         doc = json.load(fp)
@@ -410,6 +435,7 @@ def analyze(path: str) -> str:
         "bigslice:donation": "donation",
         "bigslice:exchange": "exchange",
         "bigslice:spill": "spill",
+        "bigslice:adaptive": "adaptive",
     }
     n_tasks = n_instants = 0
     for ev in doc.get("traceEvents", []):

@@ -653,6 +653,34 @@ class DeviceTelemetry:
         with self._lock:
             return self._hbm_limit_bytes
 
+    def cost_bytes(self, op_base: str) -> Optional[int]:
+        """The measured ``cost_analysis()`` bytes-accessed for one
+        pipeline site: the max across compiled programs of every op
+        whose #N-suffix-stripped base matches ``op_base`` (iterative
+        drivers re-invoke the same site under fresh suffixed names).
+        None when no program of that site ever compiled under
+        telemetry — callers fall back to their staged-bytes
+        heuristics."""
+        best = 0.0
+        with self._lock:
+            for op, rec in self._ops.items():
+                if op.split("#", 1)[0] != op_base:
+                    continue
+                if rec.bytes_accessed > best:
+                    best = rec.bytes_accessed
+        return int(best) if best > 0 else None
+
+    def total_cost_bytes(self) -> int:
+        """Session-total ``cost_analysis()`` bytes-accessed across
+        every compiled program. Deltas around an invocation measure
+        its compile-time cost footprint (the serving plane's predicted
+        invocation cost; cached programs contribute once — at their
+        first compile — which is exactly the prediction-stability the
+        admission gate wants)."""
+        with self._lock:
+            return int(sum(r.bytes_accessed
+                           for r in self._ops.values()))
+
     # -- queries ----------------------------------------------------------
 
     def status_line(self) -> Optional[str]:

@@ -75,9 +75,11 @@ _site("io.commit", ("io",),
 _site("store.put", ("io",),
       "FileStore.put entry: transient write failure before any frame "
       "is consumed, retried")
-_site("store.read", ("lose",),
-      "Store read: the committed output vanishes (file removed / memory "
-      "entry dropped) -> Missing -> DepLost -> producer recompute")
+_site("store.read", ("lose", "slow"),
+      "Store read: 'lose' = the committed output vanishes (file removed "
+      "/ memory entry dropped) -> Missing -> DepLost -> producer "
+      "recompute; 'slow' = a seeded deterministic per-read delay (a "
+      "reproducible slow disk / hot host for straggler tests)")
 _site("codec.read", ("flip", "truncate"),
       "codec.read_stream: corrupt one frame's body bytes (bit-flip -> "
       "checksum mismatch; truncate -> short body) -> CorruptionError -> "
@@ -96,10 +98,11 @@ _site("spill.read", ("lose",),
       "SpillExchange.read_partition: a spilled shuffle partition "
       "vanishes (file dropped) -> Missing -> DepLost -> the producer "
       "group recomputes and re-spills")
-_site("mesh.dispatch", ("infra", "hostloss"),
+_site("mesh.dispatch", ("infra", "hostloss", "slow"),
       "SPMD group dispatch: 'infra' = XLA-runtime-class failure "
       "(probation -> host-tier resubmit); 'hostloss' = gang-member loss "
-      "(PeerLostError -> elastic mesh recovery)")
+      "(PeerLostError -> elastic mesh recovery); 'slow' = a seeded "
+      "deterministic pre-dispatch delay (a reproducible straggler host)")
 _site("peer.lost", ("lost",),
       "Keepalive.check: a peer's beat judged stale -> PeerLostError")
 _site("eval.resubmit", ("lose",),
@@ -166,6 +169,32 @@ def injected_error(fault: Fault) -> BaseException:
     return _mark(InjectedLoss(
         f"injected loss ({fault.describe()})"
     ), fault)
+
+
+# Base for 'slow'-kind delays. The actual delay for a fault is
+# base * (1 + _unit(seed, site + "#slow", inv_id)) — between 1x and 2x
+# the base, a pure function of the plan seed, so a slow-host chaos plan
+# replays the exact same straggler profile run over run.
+DEFAULT_SLOW_S = 0.05
+
+
+def slow_delay_s(fault: Fault) -> float:
+    """The deterministic delay (seconds) a 'slow' fault carries."""
+    base = float(os.environ.get("BIGSLICE_CHAOS_SLOW_S", DEFAULT_SLOW_S))
+    p = _PLAN
+    seed = p.seed if p is not None else 0
+    return base * (1.0 + _unit(seed, fault.site + "#slow", fault.inv_id))
+
+
+def absorb_slow(fault: Optional[Fault]) -> Optional[Fault]:
+    """Seam helper for sites registered with the 'slow' kind: sleep the
+    fault's deterministic delay and absorb it (return None) so the seam's
+    raising ladder never sees it; any other fault (or None) passes
+    through unchanged."""
+    if fault is None or fault.kind != "slow":
+        return fault
+    time.sleep(slow_delay_s(fault))
+    return None
 
 
 def fault_site_of(e: Optional[BaseException]) -> Optional[str]:

@@ -198,6 +198,12 @@ class TelemetryHub:
         # ride telemetry_summary()["serving"] and /debug/metrics like
         # every other signal family. None outside a serving process.
         self.serving = None
+        # Adaptive plane (exec/adaptive.py): the Session attaches its
+        # planner's AdaptiveStats here when BIGSLICE_ADAPTIVE engages
+        # at least one policy, so decisions ride summary()["adaptive"]
+        # and the bigslice_adaptive_* Prometheus families. None with
+        # the knob unset — neither family ever emits a sample then.
+        self.adaptive = None
         self.skew_ratio = skew_ratio
         self.skew_min_rows = skew_min_rows
         self.straggler_factor = straggler_factor
@@ -523,6 +529,28 @@ class TelemetryHub:
 
     # -- queries ----------------------------------------------------------
 
+    def skew_of_op(self, op: str) -> Optional[dict]:
+        """One op's CURRENT shuffle-skew verdict (the adaptive
+        planner's hot-shard signal, exec/adaptive.py): ratio, hot
+        shard, totals and the flag, from the accumulated per-partition
+        row vector. None before the op's first shuffle boundary."""
+        with self._lock:
+            rec = self._ops.get(op)
+            if rec is None or not rec.part_rows:
+                return None
+            ratio, max_shard, median, total = self._skew_of(
+                rec.part_rows
+            )
+            return {
+                "ratio": ratio,
+                "max_shard": max_shard,
+                "median_rows": median,
+                "total_rows": total,
+                "max_rows": rec.part_rows[max_shard],
+                "flagged": (total >= self.skew_min_rows
+                            and ratio >= self.skew_ratio),
+            }
+
     def live_stragglers(self) -> List[dict]:
         """RUNNING tasks whose elapsed time already exceeds the
         straggler threshold of their op's completed siblings."""
@@ -545,6 +573,18 @@ class TelemetryHub:
                             "p50_s": round(p50, 6),
                         })
         out.sort(key=lambda d: -d["elapsed_s"])
+        return out
+
+    def task_durations(self) -> List[float]:
+        """Every completed (OK) task duration across all ops, sorted —
+        the raw distribution behind the per-op p50/p90 rollups. The
+        adaptive A/B bench and CI smoke compute tail quantiles (p99)
+        from this to judge what speculation bought."""
+        with self._lock:
+            out: List[float] = []
+            for rec in self._ops.values():
+                out.extend(rec.durations)
+        out.sort()
         return out
 
     def summary(self) -> dict:
@@ -575,6 +615,9 @@ class TelemetryHub:
                     )
                     flagged = (total >= self.skew_min_rows
                                and ratio >= self.skew_ratio)
+                    nonempty = sorted(
+                        float(r) for r in rec.part_rows if r > 0
+                    )
                     entry["skew"] = {
                         "rows": list(rec.part_rows),
                         "bytes": list(rec.part_bytes),
@@ -584,6 +627,24 @@ class TelemetryHub:
                         "max_shard": max_shard,
                         "flagged": flagged,
                         "boundaries": rec.shuffle_boundaries,
+                        # Per-shard key-count distribution from the
+                        # exchange manifest vector — the one signal the
+                        # adaptive planner and the future kernel
+                        # selector (ROADMAP item 4) both read.
+                        "per_shard": {
+                            "n": len(rec.part_rows),
+                            "nonempty": len(nonempty),
+                            "p50_rows": round(
+                                quantile(nonempty, 0.5), 1
+                            ) if nonempty else 0.0,
+                            "p90_rows": round(
+                                quantile(nonempty, 0.9), 1
+                            ) if nonempty else 0.0,
+                            "max_rows": int(max(rec.part_rows)),
+                            "mean_rows": round(
+                                total / max(1, len(rec.part_rows)), 1
+                            ),
+                        },
                     }
                     if flagged:
                         flagged_ops.append(op)
@@ -672,6 +733,12 @@ class TelemetryHub:
                 out["serving"] = serving.summary()
             except Exception:
                 out["serving"] = {}
+        adaptive = self.adaptive
+        if adaptive is not None:
+            try:
+                out["adaptive"] = adaptive.summary()
+            except Exception:
+                out["adaptive"] = {}
         return out
 
     @staticmethod
@@ -988,6 +1055,14 @@ class TelemetryHub:
         if serving is not None:
             try:
                 serving.prometheus_lines(metric, line)
+            except Exception:
+                pass
+
+        # -- adaptive plane (exec/adaptive.py decision attribution) ---
+        adaptive = self.adaptive
+        if adaptive is not None:
+            try:
+                adaptive.prometheus_lines(metric, line)
             except Exception:
                 pass
 

@@ -1,0 +1,532 @@
+"""Adaptive execution (exec/adaptive.py): the telemetry→action loop.
+
+The acceptance criteria this file pins:
+
+- BIGSLICE_ADAPTIVE unset = fully disengaged: no planner attaches, no
+  adaptive code path runs, and no ``bigslice_adaptive_*`` family ever
+  emits a sample (the chicken-bit contract);
+- hot-shard skew splitting re-runs a flagged consumer wave as K
+  row-slices BIT-IDENTICAL to the unsplit wave, on 1-D and 2-D
+  hierarchical meshes, arena on and off;
+- speculative straggler duplicates race on free slots under injected
+  ``slow`` chaos, first completion wins atomically, and every race is
+  attributed (launched = won + wasted);
+- the cost policy derives the wave/prefetch budget from the MEASURED
+  hbm_budget() and the serving plane sheds on predicted invocation
+  cost;
+- every decision lands in telemetry_summary()["adaptive"], Prometheus,
+  and the bounded decision log.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import bigslice_tpu as bs
+from bigslice_tpu.exec import adaptive as adaptive_mod
+from bigslice_tpu.exec.local import LocalExecutor
+from bigslice_tpu.exec.session import Session
+from bigslice_tpu.utils import faultinject
+
+
+def _mesh(n=4, hier=False):
+    import jax
+    from jax.sharding import Mesh
+
+    if hier:
+        return Mesh(np.array(jax.devices()[:8]).reshape(2, 4),
+                    ("dcn", "ici"))
+    return Mesh(np.array(jax.devices()[:n]), ("shards",))
+
+
+def _reduce_oracle(keys):
+    out = {}
+    for k in keys.tolist():
+        out[k] = out.get(k, 0) + 1
+    return out
+
+
+def _skewed_keys(rows=6000, nkeys=64, hot_frac=0.7, seed=7):
+    """~hot_frac of all rows on one key: one hot shuffle partition."""
+    rng = np.random.RandomState(seed)
+    return np.where(rng.rand(rows) < hot_frac, 0,
+                    rng.randint(0, nkeys, rows)).astype(np.int32)
+
+
+# ------------------------------------------------------- planner units
+
+
+def test_policies_from_env_parsing():
+    f = adaptive_mod.policies_from_env
+    assert f("") == frozenset()
+    assert f("off") == frozenset()
+    assert f("skew") == {"skew"}
+    assert f("skew,cost") == {"skew", "cost"}
+    assert f("spec+cost") == {"spec", "cost"}
+    assert f("all") == {"skew", "spec", "cost"}
+    assert f("ALL") == {"skew", "spec", "cost"}
+    with pytest.raises(ValueError):
+        f("frobnicate")
+    with pytest.raises(ValueError):
+        f("skew,frobnicate")
+
+
+def test_planner_from_env_chicken_bit(monkeypatch):
+    monkeypatch.delenv("BIGSLICE_ADAPTIVE", raising=False)
+    assert adaptive_mod.planner_from_env() is None
+    monkeypatch.setenv("BIGSLICE_ADAPTIVE", "off")
+    assert adaptive_mod.planner_from_env() is None
+    monkeypatch.setenv("BIGSLICE_ADAPTIVE", "all")
+    planner = adaptive_mod.planner_from_env()
+    assert planner is not None
+    assert planner.policies == {"skew", "spec", "cost"}
+
+
+def test_disengaged_by_default_no_samples(monkeypatch):
+    """Knob unset: no planner on the session OR executor, no adaptive
+    section in the summary, zero bigslice_adaptive_* samples."""
+    monkeypatch.delenv("BIGSLICE_ADAPTIVE", raising=False)
+    sess = Session(executor=LocalExecutor(procs=2))
+    assert sess.adaptive is None
+    assert getattr(sess.executor, "adaptive", None) is None
+    assert sess.telemetry.adaptive is None
+    res = sess.run(bs.Const(2, np.arange(64, dtype=np.int32)))
+    assert len(list(res.rows())) == 64
+    assert "adaptive" not in sess.telemetry_summary()
+    assert "bigslice_adaptive" not in sess.telemetry.prometheus_text()
+
+
+class _FakeHub:
+    """Just enough hub for planner unit tests."""
+
+    def __init__(self, skew=None, limit=None):
+        self._skew = skew or {}
+        self.events = []
+
+        class _Dev:
+            def hbm_budget(_self):
+                return limit
+
+        self.device = _Dev()
+
+    def skew_of_op(self, op):
+        return self._skew.get(op)
+
+    def _emit(self, name, **fields):
+        self.events.append((name, fields))
+
+
+def test_skew_split_k_power_of_two_dividing_cap():
+    hub = _FakeHub(skew={"prod": {
+        "ratio": 5.4, "max_shard": 2, "median_rows": 100.0,
+        "total_rows": 4000, "max_rows": 540, "flagged": True,
+    }})
+    p = adaptive_mod.AdaptivePlanner(hub, {"skew"})
+    # want = min(5, 8, cap): cap 8 -> K=4; cap 6 -> 4 % 6 != 0 -> K=2.
+    assert p.skew_split_k(["prod"], 8) == 4
+    assert p.skew_split_k(["prod"], 6) == 2
+    assert p.skew_split_k(["other"], 8) == 0      # no signal
+    assert p.stats.skew_splits == 2
+    assert any(n == "bigslice:adaptive" for n, _ in hub.events)
+
+
+def test_skew_split_k_respects_flag_and_policy():
+    unflagged = {"prod": {"ratio": 9.0, "max_shard": 0,
+                          "median_rows": 1.0, "total_rows": 10,
+                          "max_rows": 9, "flagged": False}}
+    p = adaptive_mod.AdaptivePlanner(_FakeHub(skew=unflagged), {"skew"})
+    assert p.skew_split_k(["prod"], 8) == 0
+    flagged = {"prod": {"ratio": 9.0, "max_shard": 0,
+                        "median_rows": 1.0, "total_rows": 5000,
+                        "max_rows": 4500, "flagged": True}}
+    off = adaptive_mod.AdaptivePlanner(_FakeHub(skew=flagged), {"cost"})
+    assert off.skew_split_k(["prod"], 8) == 0     # policy not engaged
+
+
+def test_skew_split_k_max_split_cap(monkeypatch):
+    monkeypatch.setenv("BIGSLICE_ADAPTIVE_MAX_SPLIT", "4")
+    hub = _FakeHub(skew={"prod": {
+        "ratio": 60.0, "max_shard": 1, "median_rows": 10.0,
+        "total_rows": 9000, "max_rows": 600, "flagged": True,
+    }})
+    p = adaptive_mod.AdaptivePlanner(hub, {"skew"})
+    assert p.skew_split_k(["prod"], 16) == 4
+
+
+def test_cost_wave_budget_measured_headroom():
+    p = adaptive_mod.AdaptivePlanner(_FakeHub(limit=1 << 20), {"cost"},
+                                     headroom=0.5)
+    assert p.cost_wave_budget("op") == 1 << 19
+    # Decision deduped per op.
+    p.cost_wave_budget("op")
+    assert p.stats.count("cost", "wave_budget") == 1
+    # No measured limit -> no budget (callers fall back to unshaped).
+    none = adaptive_mod.AdaptivePlanner(_FakeHub(limit=None), {"cost"})
+    assert none.cost_wave_budget("op") is None
+    off = adaptive_mod.AdaptivePlanner(_FakeHub(limit=1 << 20),
+                                       {"skew"})
+    assert off.cost_wave_budget("op") is None
+
+
+def test_stats_bounded_decisions_and_summary():
+    st = adaptive_mod.AdaptiveStats({"skew", "spec"})
+    for i in range(adaptive_mod.MAX_DECISIONS + 40):
+        st.record("skew", "split", op=f"op{i}", k=2)
+    st.record("spec", "launched", task="t")
+    st.record("spec", "won", task="t")
+    doc = st.summary()
+    assert doc["policies"] == ["skew", "spec"]
+    assert doc["counts"]["skew"]["split"] == \
+        adaptive_mod.MAX_DECISIONS + 40
+    assert doc["speculative"] == {"launched": 1, "won": 1, "wasted": 0}
+    assert len(doc["decisions"]) <= adaptive_mod.MAX_DECISIONS + 2
+    assert doc["decisions"][-1]["action"] == "won"
+
+
+# ------------------------------------- the slow chaos kind (satellite)
+
+
+def test_slow_kind_parses_and_is_deterministic():
+    plan = faultinject.parse_plan("7:store.read=1.0x2~slow")
+    f = plan.fire("store.read")
+    assert f is not None and f.kind == "slow"
+    base = 0.05
+    d1 = faultinject.slow_delay_s(f)
+    d2 = faultinject.slow_delay_s(f)
+    assert d1 == d2                          # pure function of the plan
+    assert base <= d1 <= 2 * base            # 1x..2x base
+    for site in ("store.read", "mesh.dispatch"):
+        faultinject.parse_plan(f"3:{site}=0.5~slow")
+    with pytest.raises(ValueError):
+        faultinject.parse_plan("3:eval.resubmit=0.5~slow")
+
+
+def test_absorb_slow_sleeps_and_clears(monkeypatch):
+    monkeypatch.setenv("BIGSLICE_CHAOS_SLOW_S", "0.05")
+    fault = faultinject.Fault("store.read", "slow", 0)
+    t0 = time.monotonic()
+    assert faultinject.absorb_slow(fault) is None
+    assert time.monotonic() - t0 >= 0.05
+    # Non-slow faults pass through untouched; None stays None.
+    lose = faultinject.Fault("store.read", "lose", 0)
+    assert faultinject.absorb_slow(lose) is lose
+    assert faultinject.absorb_slow(None) is None
+
+
+def test_slow_store_read_degrades_nothing(monkeypatch):
+    """A slow fault is latency, not loss: the read succeeds and no
+    recovery ladder engages."""
+    monkeypatch.setenv("BIGSLICE_CHAOS_SLOW_S", "0.01")
+    faultinject.install(faultinject.parse_plan(
+        "5:store.read=1.0x3~slow"))
+    try:
+        sess = Session(executor=LocalExecutor(procs=2))
+        keys = np.arange(800, dtype=np.int32) % 13
+        res = sess.run(bs.Reduce(bs.Const(4, keys,
+                                          np.ones(800, np.int32)),
+                                 lambda a, b: a + b))
+        assert dict(res.rows()) == _reduce_oracle(keys)
+        assert sess.telemetry_summary().get("recovery") is None
+    finally:
+        faultinject.clear()
+
+
+# --------------------------- skew splitting: bit-parity on real meshes
+
+
+def _skew_pipeline(keys):
+    # Reshuffle materializes the skewed partition vector on the Const
+    # group; the downstream map+shuffle group (row-local, ends in
+    # shuffle) is then the splittable consumer whose dep is flagged.
+    return bs.Reduce(
+        bs.Map(bs.Reshuffle(bs.Const(8, keys,
+                                     np.ones(len(keys), np.int32))),
+               lambda k, v: (k, v + 0)),
+        lambda a, b: a + b,
+    )
+
+
+def _mesh_run(hier, arena, keys):
+    from bigslice_tpu.exec.meshexec import MeshExecutor
+
+    sess = Session(executor=MeshExecutor(_mesh(hier=hier),
+                                         staging_arena=arena))
+    res = sess.run(_skew_pipeline(keys))
+    rows = list(map(tuple, res.rows()))
+    return rows, sess
+
+
+@pytest.mark.parametrize("arena", [True, False],
+                         ids=["arena", "noarena"])
+@pytest.mark.parametrize("hier", [False, True], ids=["1d", "2x4"])
+def test_skew_split_bit_parity(hier, arena, monkeypatch):
+    """The tentpole parity matrix: a hub-flagged hot shard splits the
+    consumer wave across row-slice lanes and the merged result is
+    value-identical to the unsplit run (sorted-row comparison — the
+    substrate's contract; enumeration order follows contribution
+    arrival, exactly as the budget split's) — on flat and hierarchical
+    meshes, staging arena on and off."""
+    keys = _skewed_keys()
+    monkeypatch.delenv("BIGSLICE_ADAPTIVE", raising=False)
+    base, base_sess = _mesh_run(hier, arena, keys)
+    assert dict(base) == _reduce_oracle(keys)
+    assert base_sess.adaptive is None
+    monkeypatch.setenv("BIGSLICE_ADAPTIVE", "skew")
+    got, sess = _mesh_run(hier, arena, keys)
+    assert sorted(got) == sorted(base)
+    st = sess.adaptive.stats
+    assert st.skew_splits >= 1
+    split = [d for d in st.summary()["decisions"]
+             if d["action"] == "split"]
+    assert split and split[0]["k"] >= 2 and split[0]["ratio"] >= \
+        sess.telemetry.skew_ratio
+    # The split actually ran through the row-slice substrate.
+    assert any(k >= 2 for k in sess.executor.split_runs.values())
+    # Attribution surfaces on every plane.
+    assert sess.telemetry_summary()["adaptive"]["counts"][
+        "skew"]["split"] >= 1
+    text = sess.telemetry.prometheus_text()
+    assert ('bigslice_adaptive_decisions_total{policy="skew",'
+            'action="split"}') in text
+
+
+# ----------------------- speculative stragglers under injected `slow`
+
+
+def test_speculative_race_under_slow_chaos(monkeypatch):
+    """Two injected slow-host reads make two live stragglers; the
+    watcher races duplicates on free slots, the atomic RUNNING→OK
+    transition picks the winner, and the result is bit-identical with
+    full attribution."""
+    monkeypatch.setenv("BIGSLICE_ADAPTIVE", "spec")
+    monkeypatch.setenv("BIGSLICE_ADAPTIVE_POLL_S", "0.005")
+    monkeypatch.setenv("BIGSLICE_CHAOS_SLOW_S", "0.5")
+    faultinject.install(faultinject.parse_plan(
+        "11:store.read=1.0x2~slow"))
+    try:
+        sess = Session(executor=LocalExecutor(procs=4))
+        # Test-scale straggler thresholds (the knobs exist for exactly
+        # this): flag a RUNNING task 1.5x beyond 2 finished siblings.
+        sess.telemetry.straggler_factor = 1.5
+        sess.telemetry.straggler_min_secs = 0.05
+        sess.telemetry.straggler_min_siblings = 2
+        rng = np.random.RandomState(3)
+        keys = rng.randint(0, 97, 4000).astype(np.int32)
+        res = sess.run(bs.Reduce(bs.Const(8, keys,
+                                          np.ones(4000, np.int32)),
+                                 lambda a, b: a + b))
+        assert dict(res.rows()) == _reduce_oracle(keys)
+        st = sess.adaptive.stats
+        # Attribution settles when the loser finishes; wait for it.
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if (st.speculative_launched >= 1
+                    and st.speculative_won + st.speculative_wasted
+                    >= st.speculative_launched):
+                break
+            time.sleep(0.02)
+        assert st.speculative_launched >= 1
+        assert (st.speculative_won + st.speculative_wasted
+                == st.speculative_launched)
+        # The duplicate re-read is NOT slowed (fault budget spent):
+        # it wins the race against a 0.5s+ sleeping original.
+        assert st.speculative_won >= 1
+        doc = sess.telemetry_summary()["adaptive"]
+        assert doc["speculative"]["launched"] >= 1
+        text = sess.telemetry.prometheus_text()
+        assert 'bigslice_adaptive_speculative_total{outcome="won"}' \
+            in text
+    finally:
+        faultinject.clear()
+
+
+def test_speculate_refuses_unsafe_tasks():
+    """Never race exclusive tasks, machine-combined tasks (duplicate
+    contribution is fatal by design), or tasks not RUNNING."""
+    from bigslice_tpu.exec.task import TaskState
+
+    ex = LocalExecutor(procs=2)
+    sess = Session(executor=ex)
+    res = sess.run(bs.Const(2, np.arange(32, dtype=np.int32)))
+    task = res.tasks[0]
+    assert task.state == TaskState.OK
+    assert ex.speculate(task) is False          # not RUNNING
+    task._local_tier = False
+    assert ex.speculate(task) is False          # not host-tier
+    sess.shutdown()
+
+
+# ------------------------------------------ cost-driven wave shaping
+
+
+def test_cost_budget_shapes_waves_and_prefetch(monkeypatch):
+    """A tight MEASURED hbm limit (no static knob) drives both relief
+    paths: the oversized wave splits into budget-bounded sub-waves and
+    the prefetch depth clips — each attributed once per op."""
+    from bigslice_tpu.exec.meshexec import MeshExecutor
+
+    monkeypatch.setenv("BIGSLICE_ADAPTIVE", "cost")
+    sess = Session(executor=MeshExecutor(_mesh(), prefetch_depth=2))
+    sess.telemetry.device.record_hbm(0, 0, limit_bytes=1 << 15,
+                                     source="test")
+    rng = np.random.RandomState(5)
+    keys = rng.randint(0, 97, 20000).astype(np.int32)
+    res = sess.run(bs.Reduce(bs.Const(16, keys,
+                                      np.ones(20000, np.int32)),
+                             lambda a, b: a + b))
+    assert dict(res.rows()) == _reduce_oracle(keys)
+    st = sess.adaptive.stats
+    counts = st.summary()["counts"]["cost"]
+    assert counts["wave_budget"] >= 1
+    assert counts["wave_split"] >= 1
+    assert counts["prefetch_clip"] >= 1
+    assert any(k >= 2 for k in sess.executor.split_runs.values())
+    budget = [d for d in st.summary()["decisions"]
+              if d["action"] == "wave_budget"][0]
+    assert budget["budget_bytes"] == 1 << 14      # limit x 0.5 headroom
+    assert budget["hbm_limit_bytes"] == 1 << 15
+
+
+def test_static_budget_knob_wins_over_adaptive(monkeypatch):
+    """An explicit device_budget_bytes knob is never overridden: the
+    cost policy only fills the gap when no knob is set."""
+    from bigslice_tpu.exec.meshexec import MeshExecutor
+
+    monkeypatch.setenv("BIGSLICE_ADAPTIVE", "cost")
+    ex = MeshExecutor(_mesh(), device_budget_bytes=1 << 26)
+    sess = Session(executor=ex)
+    sess.telemetry.device.record_hbm(0, 0, limit_bytes=1 << 15,
+                                     source="test")
+    task_probe = bs.Const(4, np.arange(64, dtype=np.int32))
+    res = sess.run(task_probe)
+    assert len(list(res.rows())) == 64
+    budget, adaptive = ex._wave_budget(res.tasks[0])
+    assert budget == 1 << 26 and adaptive is False
+
+
+def test_device_cost_bytes_accessors():
+    """Satellite: per-op cost_bytes (suffix-stripped, max over
+    programs) and the session total the serving plane deltas."""
+    from bigslice_tpu.utils.devicetelemetry import DeviceTelemetry
+
+    dev = DeviceTelemetry()
+    assert dev.cost_bytes("op") is None
+    assert dev.total_cost_bytes() == 0
+    dev.record_compile("op", 0, "group", "d1", 0.01,
+                       cost={"bytes_accessed": 100.0})
+    dev.record_compile("op#1", 0, "group", "d2", 0.01,
+                       cost={"bytes_accessed": 300.0})
+    dev.record_compile("other", 0, "group", "d3", 0.01,
+                       cost={"bytes_accessed": 50.0})
+    assert dev.cost_bytes("op") == 300
+    assert dev.cost_bytes("missing") is None
+    assert dev.total_cost_bytes() == 450
+
+
+# ----------------------------------------- serving: cost admission
+
+
+def test_serve_sheds_on_predicted_cost(monkeypatch):
+    from bigslice_tpu.serve.server import ServeServer
+
+    monkeypatch.setenv("BIGSLICE_ADAPTIVE", "cost")
+    monkeypatch.setenv("BIGSLICE_SERVE_COST_BUDGET_BYTES", "1200")
+    sess = Session()
+    srv = ServeServer(sess, port=0, slots=2, queue_depth=4)
+
+    def pipe():
+        # The pipeline's compile cost lands in the device plane while
+        # it is the SOLE invocation -> measured as its prediction.
+        sess.telemetry.device.record_compile(
+            "served-op", 0, "group", "d1", 0.01,
+            cost={"bytes_accessed": 900.0})
+        return bs.Const(1, np.arange(8, dtype=np.int32))
+
+    srv.register("measured", pipe)
+    try:
+        code, doc = srv.invoke_request({"pipeline": "measured"})
+        assert code == 200 and doc["num_rows"] == 8
+        assert srv._pipe_cost == {"measured": 900}
+        # With 500B already admitted, 500 + 900 > 1200 -> shed.
+        srv._cost_inflight = 500
+        code, doc = srv.invoke_request({"pipeline": "measured"})
+        assert code == 503 and doc.get("retry")
+        assert "predicted cost" in doc["error"]
+        srv._cost_inflight = 0
+        # Idle server always admits (the anti-livelock guard).
+        code, _ = srv.invoke_request({"pipeline": "measured"})
+        assert code == 200
+        counts = sess.adaptive.stats.summary()["counts"]["cost"]
+        assert counts["serve_measured"] >= 1
+        assert counts["serve_shed"] == 1
+        assert counts["serve_admit"] >= 1
+        outcomes = srv.stats.summary()["tenants"]["default"]["outcomes"]
+        assert outcomes["rejected_cost"] == 1 and outcomes["ok"] == 2
+        adm = srv.serving_stats()["admission"]["cost"]
+        assert adm["budget_bytes"] == 1200
+        assert adm["predicted_bytes"] == {"measured": 900}
+        assert adm["inflight_bytes"] == 0
+    finally:
+        srv.close(timeout=5)
+        sess.shutdown()
+
+
+def test_serve_cost_gate_absent_without_policy(monkeypatch):
+    from bigslice_tpu.serve.server import ServeServer
+
+    monkeypatch.delenv("BIGSLICE_ADAPTIVE", raising=False)
+    sess = Session()
+    srv = ServeServer(sess, port=0)
+    srv.register("plain",
+                 lambda: bs.Const(1, np.arange(4, dtype=np.int32)))
+    try:
+        code, _ = srv.invoke_request({"pipeline": "plain"})
+        assert code == 200
+        assert srv._pipe_cost == {}
+        assert "cost" not in srv.serving_stats()["admission"]
+    finally:
+        srv.close(timeout=5)
+        sess.shutdown()
+
+
+# -------------------------------- telemetry satellites + slicetrace
+
+
+def test_summary_skew_per_shard_stats():
+    """Satellite: the skew section carries per-shard key-count stats
+    (the raw evidence the skew policy acts on)."""
+    from bigslice_tpu.utils.telemetry import TelemetryHub
+
+    hub = TelemetryHub()
+    hub.record_shuffle("op", 0, [900, 10, 10, 12], [3600, 40, 40, 48])
+    doc = hub.summary()["ops"]["op"]["skew"]
+    ps = doc["per_shard"]
+    assert ps["n"] == 4 and ps["nonempty"] == 4
+    assert ps["max_rows"] == 900.0
+    assert ps["p50_rows"] == pytest.approx(11.0)
+    assert ps["p90_rows"] >= ps["p50_rows"]
+    assert ps["mean_rows"] == pytest.approx(233.0)
+    # The planner-facing query agrees with the summary.
+    sk = hub.skew_of_op("op")
+    assert sk["max_shard"] == 0 and sk["total_rows"] == 932
+
+
+def test_slicetrace_renders_adaptive_section(tmp_path, monkeypatch):
+    """A real skew split's bigslice:adaptive instant carries the
+    invocation tag and renders as an invN:adaptive section offline."""
+    from bigslice_tpu.exec.meshexec import MeshExecutor
+    from bigslice_tpu.tools import slicetrace
+
+    monkeypatch.setenv("BIGSLICE_ADAPTIVE", "skew")
+    trace = tmp_path / "trace.json"
+    keys = _skewed_keys()
+    sess = Session(executor=MeshExecutor(_mesh()),
+                   trace_path=str(trace))
+    res = sess.run(_skew_pipeline(keys))
+    assert dict(map(tuple, res.rows())) == _reduce_oracle(keys)
+    assert sess.adaptive.stats.skew_splits >= 1
+    sess.shutdown()  # writes the trace
+    report = slicetrace.analyze(str(trace))
+    assert ":adaptive" in report
+    assert "skew" in report and "ratio=" in report
