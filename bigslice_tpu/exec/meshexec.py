@@ -190,6 +190,16 @@ def _op_base(op: str) -> str:
     return op.split("#", 1)[0]
 
 
+def _fleet_aot_enabled() -> bool:
+    """Chicken bit for the multiprocess compile-telemetry lift: the
+    AOT seam now instruments multi-process SPMD meshes too (per-rank
+    attribution, merged post-hoc by the fleet plane);
+    ``BIGSLICE_FLEET_AOT=0`` restores the pre-fleet skip. Read lazily
+    per program build so tests and operators can flip it live."""
+    return os.environ.get("BIGSLICE_FLEET_AOT", "1").lower() \
+        not in ("0", "false", "off")
+
+
 class _AttendHostFallback(Exception):
     """A SelfAttend group's dep is not device-resident in the aligned
     row-sharded layout ring attention needs (producer ran host-tier,
@@ -1797,7 +1807,8 @@ class MeshExecutor:
         if sess is None:
             return
         notify_phase(sess.monitor, task, phase, wave)
-        sess._event(f"bigslice:{phase}", op=task.name.op, wave=wave)
+        sess._event(f"bigslice:{phase}", op=task.name.op, wave=wave,
+                    inv=task.name.inv_index)
 
     def _donation_on(self) -> bool:
         return self.donate_buffers and donation_supported()
@@ -1827,9 +1838,17 @@ class MeshExecutor:
         op + the repr-stable partition config ``key_parts``) and later
         calls count as cache hits (utils/devicetelemetry.py). No hub →
         the raw jit returns untouched (collection is no-op-cheap).
-        Multiprocess SPMD meshes skip too: the AOT argument-sharding
-        bake is per-process state and a per-process fallback would
-        diverge dispatch behavior across the gang.
+
+        Multiprocess SPMD meshes instrument too: the SPMD contract
+        (every rank runs the identical driver over the identical task
+        graph — the deterministic-compilation guarantee the Func
+        registry enforces) makes the AOT signature bake and any
+        fallback decision a pure function of (program, arg signature),
+        so every rank takes the same path and dispatch never diverges
+        across the gang. Each rank records its own compile/cache-hit
+        attribution; the fleet merge (utils/fleettelemetry.py) adds
+        them post-hoc. ``BIGSLICE_FLEET_AOT=0`` restores the old
+        multiprocess skip as a chicken bit.
 
         ``fns``/``extra`` feed the cross-Session program cache
         (serve/programcache.py): ``fns`` is the complete list of user
@@ -1840,7 +1859,8 @@ class MeshExecutor:
         bits). A long-lived server's fresh Sessions get their
         executables back from that cache without touching XLA."""
         dev = self._device_telemetry()
-        if dev is None or self.multiprocess:
+        if dev is None or (self.multiprocess
+                           and not _fleet_aot_enabled()):
             return prog
         try:
             # Mesh shape + axis names key the digest: a 1-D and a 2-D
@@ -1999,16 +2019,26 @@ class MeshExecutor:
         shuffle+combine programs — the mesh program's only host-visible
         per-device counts; the local tier reports pre-combine routed
         rows, so combiner-hidden skew still surfaces on mixed-tier
-        pipelines. Multi-process meshes skip: the counts sync would be
-        a host gather of a globally-sharded array."""
+        pipelines.
+
+        Multi-process meshes record too — process-locally: a host
+        gather of the globally-sharded count array would put a
+        collective on the hot path, so each rank reads only its
+        *addressable* shards and reports them at their global
+        partition offsets (``record_shuffle(indices=...)``) tagged
+        with ``jax.process_index()``. The fleet plane's post-hoc merge
+        (utils/fleettelemetry.py) sums the per-rank vectors
+        elementwise into exactly the single-process vector."""
         hub = self._telemetry_hub()
-        if hub is None or self.multiprocess:
+        if hub is None:
             return
         try:
             if isinstance(out, shuffleplan_mod.SpilledGroupOutput):
                 # Spilled boundary: the per-partition row totals come
                 # from the exchange manifest (no device counts remain
                 # to sync) — combiner-hidden skew still surfaces.
+                # (Spill plans are multiprocess-ineligible, so this is
+                # always the whole-group single-process view.)
                 rows = out.exchange.partition_rows()
                 rowbytes = sum(
                     np.dtype(ct.dtype).itemsize for ct in task0.schema
@@ -2018,10 +2048,21 @@ class MeshExecutor:
                     [r * rowbytes for r in rows],
                 )
                 return
-            counts = np.asarray(out.counts).reshape(-1)
             rowbytes = sum(
                 np.dtype(c.dtype).itemsize for c in out.cols
             ) or 4
+            if self.multiprocess and not getattr(
+                    out.counts, "is_fully_addressable", True):
+                rows, indices = self._addressable_counts(out.counts)
+                if rows:
+                    hub.record_shuffle(
+                        task0.name.op, task0.name.inv_index, rows,
+                        [r * rowbytes for r in rows],
+                        indices=indices,
+                        rank=int(jax.process_index()),
+                    )
+                return
+            counts = np.asarray(out.counts).reshape(-1)
             hub.record_shuffle(
                 task0.name.op, task0.name.inv_index,
                 [int(c) for c in counts],
@@ -2029,6 +2070,30 @@ class MeshExecutor:
             )
         except Exception:
             pass
+
+    @staticmethod
+    def _addressable_counts(counts):
+        """This rank's slice of a globally-sharded per-device count
+        array as ``(rows, global_flat_indices)`` — read shard-by-shard
+        from ``addressable_shards`` (device-local transfers only, no
+        collective). Shard index offsets are mapped through the global
+        shape so hierarchical (2-D) meshes flatten to the same
+        partition order the single-process ``reshape(-1)`` view
+        uses."""
+        shape = counts.shape
+        rows: List[int] = []
+        indices: List[int] = []
+        for sh in counts.addressable_shards:
+            data = np.asarray(sh.data).reshape(-1)
+            start = tuple(
+                (sl.start or 0) for sl in sh.index
+            ) if sh.index else ()
+            flat0 = int(np.ravel_multi_index(start, shape)) \
+                if start else 0
+            for j, c in enumerate(data):
+                rows.append(int(c))
+                indices.append(flat0 + j)
+        return rows, indices
 
     def _wave_budget(self, task0: Task):
         """The per-device wave working-set budget the split and

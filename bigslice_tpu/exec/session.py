@@ -248,7 +248,8 @@ class Session:
                  machine_combiners: bool = False,
                  debug_port: Optional[int] = None,
                  xprof_dir: Optional[str] = None,
-                 elastic: int = 0, mesh_provider=None):
+                 elastic: int = 0, mesh_provider=None,
+                 fleet_dir: Optional[str] = None):
         from bigslice_tpu.utils import status as status_mod
         from bigslice_tpu.utils import trace as trace_mod
 
@@ -303,6 +304,26 @@ class Session:
             self.telemetry = telemetry_mod.TelemetryHub(
                 eventer=self._event
             )
+        # Fleet telemetry plane (utils/fleettelemetry.py): with a fleet
+        # dir configured (kwarg or BIGSLICE_FLEET_DIR — any fsspec URL)
+        # and the hub enabled, this rank exports its mergeable snapshot
+        # through the Store seam periodically, at every run end, and at
+        # shutdown; rank 0 pulls + merges every rank's file into
+        # telemetry_summary(scope="fleet") / fleet.json. No fleet dir
+        # (or BIGSLICE_TELEMETRY=0) → no exporter, zero files written.
+        self.fleet = None
+        fleet_dir = fleet_dir or os.environ.get("BIGSLICE_FLEET_DIR") \
+            or None
+        if fleet_dir and self.telemetry is not None:
+            from bigslice_tpu.utils import fleettelemetry as fleet_mod
+
+            try:
+                self.fleet = fleet_mod.FleetExporter(
+                    self.telemetry, fleet_dir
+                )
+                self.fleet.start()
+            except Exception:  # telemetry must never break the run
+                self.fleet = None
         self.status = status_mod.Status()
         self.status.set_telemetry(self.telemetry)
         stats_fn = getattr(self.executor, "resource_stats", None)
@@ -374,7 +395,18 @@ class Session:
                 self.telemetry.adaptive = planner.stats
             executor.adaptive = planner
         executor.start(self)
-        self._event("bigslice:sessionStart", executor=executor.name)
+        # Rank-stamp the start event on multi-process gangs so
+        # slicetrace's N-file merge (--merge) can assign each per-rank
+        # trace its lane without relying on filenames; single-process
+        # traces stay byte-identical (no rank field).
+        from bigslice_tpu.utils.telemetry import _process_rank
+
+        rank = _process_rank()
+        if rank is None:
+            self._event("bigslice:sessionStart", executor=executor.name)
+        else:
+            self._event("bigslice:sessionStart", executor=executor.name,
+                        rank=rank)
 
     def _event(self, name: str, **fields) -> None:
         if self.eventer is not None:
@@ -388,12 +420,22 @@ class Session:
         if state == TaskState.OK:
             self.eventer("bigslice:taskComplete", task=str(task.name))
 
-    def run(self, func: Any, *args) -> Result:
+    def run(self, func: Any, *args, corr: Optional[str] = None
+            ) -> Result:
         """Compile and evaluate ``func(*args)`` (exec/session.go:214-225).
 
         ``func`` may be a registered ``Func``, a plain slice-returning
         callable, or a ``Slice`` directly (test convenience, mirroring
         slicetest.Run).
+
+        ``corr`` is the cross-rank correlation id: the serving plane
+        mints one per request (deterministic across SPMD ranks — every
+        rank's ServeServer sees the identical request stream) and
+        threads it here, so the invocation instant in every rank's
+        trace carries the same id and slicetrace's merged timeline can
+        join one serve request to its waves and tasks on every rank.
+        Defaults to ``inv<index>`` — itself identical across ranks by
+        the shared-invocation-counter contract.
         """
         exclusive = False
         if isinstance(func, Func):
@@ -423,6 +465,7 @@ class Session:
         # location, stringified args). Built only when something
         # consumes events; reprlib bounds the arg stringification
         # (repr(huge_list)[:64] would materialize the whole string).
+        corr = corr or f"inv{inv_index}"
         if self.eventer is not None or self.tracer is not None:
             import reprlib
 
@@ -430,6 +473,7 @@ class Session:
             self._event(
                 f"bigslice:invocation:{inv_index}",
                 inv=inv_index,
+                corr=corr,
                 location=f"{loc[0]}:{loc[1]}" if loc else "?",
                 args=", ".join(reprlib.repr(a) for a in args),
             )
@@ -527,7 +571,18 @@ class Session:
                 attempts += 1
         finally:
             self._gate.release(exclusive)
-        return Result(self, slice_, tasks)
+            # Run-end fleet export (success or fatal): the snapshot
+            # file is the one artifact a peer's merge can read, so it
+            # must be current the moment this rank's run settles — the
+            # periodic thread alone could lag a full period.
+            if self.fleet is not None:
+                try:
+                    self.fleet.export()
+                except Exception:
+                    pass
+        res = Result(self, slice_, tasks)
+        res.corr = corr
+        return res
 
     def _mesh_signature(self):
         """The executor's repr-stable mesh-topology signature (axis
@@ -631,8 +686,24 @@ class Session:
                             inv=inv_index, path=path)
         except Exception:
             pass
+        # Fleet post-mortem: push this rank's flight doc through the
+        # store, and let the coordinator collate every rank's dump
+        # into one bundle — a multihost failure leaves one coherent
+        # artifact instead of N scattered per-host files.
+        if self.fleet is not None:
+            try:
+                self.fleet.export_flight(
+                    self.telemetry.flight_doc(inv=inv_index,
+                                              reason=repr(err))
+                )
+                bundle = self.fleet.collate_flights()
+                if bundle:
+                    self._event("bigslice:postmortem",
+                                inv=inv_index, bundle=bundle)
+            except Exception:
+                pass
 
-    def telemetry_summary(self) -> dict:
+    def telemetry_summary(self, scope: str = "session") -> dict:
         """The telemetry hub's aggregated signals (utils/telemetry.py):
         per-op task-duration quantiles + stragglers, shuffle-boundary
         skew (per-shard rows/bytes, max/median ratio, hot shard),
@@ -643,9 +714,26 @@ class Session:
         throughput so the perf trajectory carries overlap efficiency
         and compile cost alongside rows/sec; tests assert skew flagging
         through it. Empty when the hub is disabled
-        (BIGSLICE_TELEMETRY=0)."""
+        (BIGSLICE_TELEMETRY=0).
+
+        ``scope="fleet"`` returns the cross-rank merge instead: every
+        rank's exported snapshot pulled through the store and merged
+        (utils/fleettelemetry.py) — per-op skew recomputed from the
+        elementwise-summed partition vectors, task quantiles from the
+        merged fixed-bin histograms, compile/exchange/HBM attribution
+        per rank. Without a fleet exporter it degrades to merging this
+        process's own snapshot (a 1-rank fleet), so the fleet shape is
+        always available for tooling."""
         if self.telemetry is None:
             return {}
+        if scope == "fleet":
+            from bigslice_tpu.utils import fleettelemetry as fleet_mod
+
+            if self.fleet is not None:
+                return self.fleet.fleet_summary()
+            return fleet_mod.merge_snapshots(
+                [self.telemetry.snapshot()]
+            )
         return self.telemetry.summary()
 
     # Go-flavored alias (Session.Must): raise on error is Python's default.
@@ -661,6 +749,15 @@ class Session:
         if self.serve is not None:
             try:
                 self.serve.close()
+            except Exception:
+                pass
+        # Final fleet export BEFORE the executor (and its mesh) goes
+        # away: everything is recorded by now, and rank 0's close also
+        # waits (bounded) for peer files and writes the merged
+        # fleet.json beside them.
+        if self.fleet is not None:
+            try:
+                self.fleet.close()
             except Exception:
                 pass
         close = getattr(self.executor, "close", None)

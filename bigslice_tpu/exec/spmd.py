@@ -48,10 +48,19 @@ def spmd_session(mesh=None, parallelism: Optional[int] = None,
     host (and profiling windows are per-process anyway; the
     coordinator's is the one an operator asks for first).
 
-    Note: on multi-process meshes the compile-telemetry AOT seam is
-    off by design (per-process executable state must not diverge gang
-    dispatch); HBM watermarks and donation effectiveness still record
-    from each process's local devices.
+    Telemetry is fleet-wide: every signal family — compile
+    attribution (the AOT seam now instruments multi-process meshes
+    too; the SPMD same-driver contract keeps its signature bake and
+    fallback decisions identical on every rank,
+    ``BIGSLICE_FLEET_AOT=0`` restores the old skip), shuffle-boundary
+    partition counts (each rank records its addressable shards at
+    their global offsets — no hot-path collective), HBM watermarks,
+    stragglers, exchange and recovery — records process-locally per
+    rank. Set ``BIGSLICE_FLEET_DIR`` (or the ``fleet_dir=`` session
+    kwarg) to a shared store URL and each rank exports its mergeable
+    snapshot there; rank 0 merges them into
+    ``telemetry_summary(scope="fleet")``, ``/debug/fleet``, and
+    ``fleet.json`` at shutdown (utils/fleettelemetry.py).
 
     Mesh shape: ``BIGSLICE_MESH_SHAPE=DxI`` builds the 2-D DCN × ICI
     hierarchy (``Mesh(devices.reshape(D, I), ("dcn", "ici"))`` —

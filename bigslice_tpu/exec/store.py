@@ -78,11 +78,37 @@ class Store:
     def discard(self, name: TaskName) -> None:
         raise NotImplementedError
 
+    # -- aux blobs (the fleet-telemetry seam) ----------------------------
+    #
+    # Small named artifacts that ride the same storage substrate as
+    # partition data but are not task outputs: per-rank telemetry
+    # snapshots, the merged fleet summary, flight-recorder post-mortem
+    # bundles (utils/fleettelemetry.py). Deterministic names instead of
+    # a listing API keep the seam as thin as partition reads — readers
+    # probe ``telemetry-rank{r}.json`` directly.
+
+    def put_aux(self, aux_name: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def get_aux(self, aux_name: str) -> Optional[bytes]:
+        """The blob's bytes, or None when absent (absence is a normal
+        state while a peer rank hasn't exported yet)."""
+        raise NotImplementedError
+
 
 class MemoryStore(Store):
     def __init__(self):
         self._lock = threading.Lock()
         self._data: Dict[Tuple[TaskName, int], List[Frame]] = {}
+        self._aux: Dict[str, bytes] = {}
+
+    def put_aux(self, aux_name, data):
+        with self._lock:
+            self._aux[aux_name] = bytes(data)
+
+    def get_aux(self, aux_name):
+        with self._lock:
+            return self._aux.get(aux_name)
 
     def put(self, name, partition, frames):
         # Consume OUTSIDE the lock: callers may hand in lazy streams
@@ -177,6 +203,27 @@ class FileStore(Store):
             f"{name.shard}-of-{name.num_shard}",
             f"p{partition}",
         )
+
+    def _aux_path(self, aux_name: str) -> str:
+        return fileio.join(self.prefix, "aux",
+                           aux_name.replace("/", "_"))
+
+    def put_aux(self, aux_name, data):
+        # atomic_write's tmp+rename contract: a concurrent get_aux
+        # sees either the previous complete blob or the new one, never
+        # a partial file — the property the fleet merge relies on when
+        # rank 0 polls while peers are mid-export.
+        with fileio.atomic_write(self._aux_path(aux_name)) as fp:
+            fp.write(bytes(data))
+
+    def get_aux(self, aux_name):
+        try:
+            with fileio.open_read(self._aux_path(aux_name)) as fp:
+                return fp.read()
+        except FileNotFoundError:
+            return None
+        except Exception:  # transient backend error == not-yet-there
+            return None
 
     def put(self, name, partition, frames):
         if faultinject.ENABLED:

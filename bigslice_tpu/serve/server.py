@@ -41,6 +41,7 @@ The debug surface (``/debug/*``) rides on the same listener via the
 from __future__ import annotations
 
 import hashlib
+import itertools
 import json
 import os
 import sys
@@ -275,6 +276,12 @@ class ServeServer(DebugServer):
         # path is untouched.
         self._pipe_cost: Dict[str, int] = {}
         self._cost_inflight = 0
+        # Correlation-id sequence: invocations with no caller-supplied
+        # ``corr`` get ``<pipeline>:<seq>``. Deterministic across SPMD
+        # ranks by the same-driver contract (every rank's server sees
+        # the identical invocation stream in the same order), so the
+        # id stitches one serve request across every rank's trace.
+        self._corr_seq = itertools.count(1)
         self._started = time.time()
         super().__init__(session, port)
         self._hook_session(session)
@@ -443,6 +450,13 @@ class ServeServer(DebugServer):
         name = req.get("pipeline")
         args = req.get("args") or []
         tenant = str(req.get("tenant") or self.default_tenant)
+        # Correlation id: caller-supplied (end-to-end tracing across
+        # services) or minted here — threaded through Session.run into
+        # every rank's trace and echoed in the response, so one serve
+        # request is traceable request → evaluation → wave → task on
+        # every rank (slicetrace --merge joins on it).
+        corr = str(req.get("corr") or "") \
+            or f"{name}:{next(self._corr_seq)}"
         want_rows = bool(req.get("rows", True))
         try:
             max_rows = int(req.get("max_rows", DEFAULT_MAX_ROWS))
@@ -531,7 +545,8 @@ class ServeServer(DebugServer):
         t0 = time.perf_counter()
         b0 = self._cost_probe() if planner is not None else 0
         try:
-            doc = self._run(pipe, args, want_rows, max_rows)
+            doc = self._run(pipe, args, want_rows, max_rows,
+                            corr=corr)
             if planner is not None:
                 self._cost_measure(planner, name, b0, sole)
         except Exception as e:  # noqa: BLE001 — serve errors as JSON
@@ -540,6 +555,7 @@ class ServeServer(DebugServer):
             return 500, {
                 "error": f"{type(e).__name__}: {e}",
                 "pipeline": name,
+                "corr": corr,
                 "latency_s": round(latency, 6),
             }
         finally:
@@ -554,6 +570,7 @@ class ServeServer(DebugServer):
         doc.update({
             "pipeline": name,
             "tenant": tenant,
+            "corr": corr,
             "latency_s": round(latency, 6),
         })
         return 200, doc
@@ -595,11 +612,12 @@ class ServeServer(DebugServer):
                             f"{pipe.name}-{digest[:12]}")
 
     def _run(self, pipe: Pipeline, args, want_rows: bool,
-             max_rows: int) -> dict:
+             max_rows: int, corr: Optional[str] = None) -> dict:
         """Evaluate one invocation on the shared Session. Cached
         pipelines build their slice and run it under the ops/cache.py
         writethrough tier; plain ones go straight through
-        ``Session.run`` (Func memoization and pragmas intact)."""
+        ``Session.run`` (Func memoization and pragmas intact).
+        ``corr`` rides into the run's invocation trace instant."""
         session = self.session
         if pipe.cache:
             from bigslice_tpu.ops.base import Slice
@@ -612,10 +630,10 @@ class ServeServer(DebugServer):
                     f"{type(slice_).__name__}, expected a Slice"
                 )
             res = session.run(Cache(slice_,
-                                    self._cache_prefix(pipe, args)))
+                                    self._cache_prefix(pipe, args)),
+                              corr=corr)
         else:
-            res = session.run(pipe.fn, *args)
-        import itertools
+            res = session.run(pipe.fn, *args, corr=corr)
 
         rows: List[list] = []
         num_rows = 0

@@ -11,7 +11,9 @@ DCN shape of a TPU pod):
 
 Usage (parent):  python -m bigslice_tpu.tools.multihost_smoke [N]
 The parent acts as process 0; children run the same module with
-``--worker``.
+``--worker``. ``--telemetry [--out DIR]`` runs the fleet-observability
+smoke instead: 2 ranks with per-rank traces and a shared fleet store,
+asserting the merged fleet summary carries both ranks' attribution.
 """
 
 from __future__ import annotations
@@ -611,8 +613,179 @@ def killrun_worker(num_processes: int, process_id: int,
         os._exit(0 if ok else 1)
 
 
+def telemetry_worker(num_processes: int, process_id: int, port: int,
+                     out_dir: str) -> int:
+    """Fleet-telemetry smoke (the observability plane across REAL
+    process boundaries): every rank runs the same skewed reduce with a
+    per-rank trace file and a shared fleet store, exports its mergeable
+    snapshot, and rank 0 pulls + merges and asserts the fleet summary
+    actually carries BOTH ranks' attribution — per-rank shuffle rows at
+    global partition offsets (the lifted multiprocess shuffle-boundary
+    skip), per-rank compile counts (the lifted AOT seam), and per-rank
+    exchange messages."""
+    from bigslice_tpu.utils.hermetic import force_hermetic_cpu
+
+    force_hermetic_cpu()
+    import json
+
+    import numpy as np
+
+    from bigslice_tpu.utils import distributed
+
+    distributed.initialize(
+        coordinator=f"127.0.0.1:{port}",
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    import bigslice_tpu as bs
+    from bigslice_tpu.exec import spmd as spmd_mod
+
+    mesh = distributed.global_mesh()
+    n = int(mesh.devices.size)
+    sess = spmd_mod.spmd_session(
+        mesh,
+        trace_path=os.path.join(out_dir, f"trace-rank{process_id}.json"),
+        fleet_dir=out_dir,
+    )
+    assert sess.fleet is not None
+    client = distributed._coordination_client()
+
+    def add(a, b):
+        return a + b
+
+    # Skewed keys (identical on every rank — same-driver contract): a
+    # hot head so the fleet skew section carries real numbers.
+    rng = np.random.RandomState(11)
+    keys = (rng.zipf(1.3, n * 64) % 23).astype(np.int32)
+    red = bs.Reduce(bs.Const(n, keys, np.ones(len(keys), np.int32)),
+                    add)
+    res = sess.run(red, corr="smoke:1")
+    assert res.corr == "smoke:1"
+    got = dict(res.rows())
+    expect: dict = {}
+    for kk in keys.tolist():
+        expect[kk] = expect.get(kk, 0) + 1
+    assert got == expect, (got, expect)
+
+    # Publish this rank's snapshot NOW (the periodic exporter may not
+    # have ticked yet), then rendezvous so rank 0's pull sees everyone.
+    assert sess.fleet.export() is not None
+    try:
+        client.wait_at_barrier("bigslice_fleettelem_exported", 60_000)
+    except Exception:  # noqa: BLE001
+        pass
+
+    if process_id == 0:
+        fleet = sess.telemetry_summary(scope="fleet")
+        assert fleet.get("scope") == "fleet"
+        assert fleet.get("ranks") == list(range(num_processes)), \
+            fleet.get("ranks")
+        per_rank = fleet.get("per_rank") or {}
+        assert set(per_rank) == {str(r) for r in range(num_processes)}, \
+            sorted(per_rank)
+        # The lifted AOT seam: compile attribution on EVERY rank.
+        for r, pr in per_rank.items():
+            assert pr["compiles"] > 0, (r, pr)
+            assert pr["exchange_messages"] > 0, (r, pr)
+        # The lifted shuffle-boundary skip: the reduce op's merged skew
+        # vector spans the global partition space, with every rank's
+        # addressable contribution tagged in per_rank_rows.
+        skews = {op: e["skew"] for op, e in fleet["ops"].items()
+                 if "skew" in e}
+        assert skews, sorted(fleet["ops"])
+        op, skew = next(iter(skews.items()))
+        # Rows per partition are post-combine (distinct keys): the
+        # merged vector spans the global partition space and sums to
+        # the global distinct-key count — each rank contributed only
+        # its addressable shards, so the total being right PROVES the
+        # offsets interleaved instead of double-counting.
+        assert len(skew["rows"]) == n, skew["rows"]
+        assert sum(skew["rows"]) == len(expect), (skew, len(expect))
+        prr = skew["per_rank_rows"]
+        assert set(prr) == {str(r) for r in range(num_processes)}, prr
+        assert all(v > 0 for v in prr.values()), prr
+        with open(os.path.join(out_dir, "fleet-summary.json"),
+                  "w") as fp:
+            json.dump(fleet, fp, indent=2, sort_keys=True)
+
+    try:
+        client.wait_at_barrier("bigslice_fleettelem_checked", 60_000)
+    except Exception:  # noqa: BLE001
+        pass
+    # shutdown(): final export, rank 0 merges fleet.json into the
+    # store, every rank writes its trace-rank<r>.json.
+    sess.shutdown()
+    try:
+        client.wait_at_barrier("bigslice_fleettelem_done", 60_000)
+    except Exception:  # noqa: BLE001
+        pass
+    if process_id == 0:
+        # Offline counterpart: obsdump --fleet over the same store must
+        # reconstruct the same rank set from the exported snapshots.
+        from bigslice_tpu.utils import fleettelemetry as fleet_mod
+
+        snaps = fleet_mod.load_snapshots(out_dir)
+        assert [s["rank"] for s in snaps] == list(range(num_processes))
+        merged = fleet_mod.merge_snapshots(snaps)
+        assert merged["ranks"] == list(range(num_processes))
+        print(f"FLEETTELEM_OK ranks={merged['ranks']} "
+              f"ops={len(merged['ops'])}", flush=True)
+    sys.stdout.flush()
+    os._exit(0)
+
+
 def main(argv=None) -> int:
     argv = argv if argv is not None else sys.argv[1:]
+    if argv and argv[0] == "--telemetry-worker":
+        return telemetry_worker(int(argv[1]), int(argv[2]),
+                                int(argv[3]), argv[4])
+    if argv and argv[0] == "--telemetry":
+        import tempfile
+
+        out_dir = None
+        rest = argv[1:]
+        if rest and rest[0] == "--out":
+            out_dir = rest[1]
+            os.makedirs(out_dir, exist_ok=True)
+        if out_dir is None:
+            out_dir = tempfile.mkdtemp(prefix="bigslice-fleet-")
+        port = _free_port()
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        cap = tempfile.TemporaryFile(mode="w+")
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-m",
+                 "bigslice_tpu.tools.multihost_smoke",
+                 "--telemetry-worker", "2", str(i), str(port), out_dir],
+                env=env,
+                stdout=cap if i == 0 else None,
+                stderr=cap if i == 0 else None,
+            )
+            for i in (0, 1)
+        ]
+        rc = 1
+        try:
+            p0rc = procs[0].wait(timeout=240)
+            cap.seek(0)
+            text = cap.read()
+            if p0rc == 0 and "FLEETTELEM_OK" in text:
+                print(f"FLEETTELEM_OK: fleet summary merged from both "
+                      f"ranks under {out_dir}", flush=True)
+                rc = 0
+            else:
+                print(f"FLEETTELEM_FAIL: rc={p0rc}\n{text[-2000:]}",
+                      flush=True)
+        except subprocess.TimeoutExpired:
+            print("FLEETTELEM_FAIL: workers hung past 240s", flush=True)
+            procs[0].kill()
+        finally:
+            for p in procs:
+                try:
+                    p.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+        sys.exit(rc)
     if argv and argv[0] == "--killrun-worker":
         return killrun_worker(int(argv[1]), int(argv[2]), int(argv[3]))
     if argv and argv[0] == "--killrun":

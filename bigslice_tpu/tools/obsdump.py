@@ -14,9 +14,19 @@ prefetch pipeline → overlap accounting) over a mildly skewed key space
 (shuffle-boundary size records), on the mesh executor with the local
 tier handling ineligible stages.
 
+``--fleet STORE_URL`` is the offline fleet-merge mode: instead of
+running a workload it pulls every rank's exported telemetry snapshot
+from the store's aux-blob area (``telemetry-rank*.json``, written by
+sessions configured with ``BIGSLICE_FLEET_DIR``) and merges them into
+one ``scope="fleet"`` summary — the same document rank 0 serves at
+``/debug/fleet`` — so an operator can reconstruct the fleet view after
+the job is gone, from nothing but the store.
+
 Usage:
     python -m bigslice_tpu.tools.obsdump --trace TRACE.json \
         --summary SUMMARY.json [--rows N]
+    python -m bigslice_tpu.tools.obsdump --fleet STORE_URL \
+        [--summary SUMMARY.json]
 """
 
 from __future__ import annotations
@@ -57,17 +67,51 @@ def run_workload(trace_path: str, rows: int = 1 << 16) -> dict:
     return summary
 
 
+def fleet_merge(store_url: str) -> dict:
+    """Pull every rank's exported snapshot from the store and merge
+    them into the ``scope="fleet"`` summary (offline counterpart of
+    rank 0's live merge)."""
+    from bigslice_tpu.utils import fleettelemetry as fleet_mod
+
+    snaps = fleet_mod.load_snapshots(store_url)
+    if not snaps:
+        raise SystemExit(
+            f"obsdump: no telemetry-rank*.json snapshots under "
+            f"{store_url!r} (was the session run with "
+            f"BIGSLICE_FLEET_DIR?)"
+        )
+    return fleet_mod.merge_snapshots(snaps)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="obsdump",
         description="dump Chrome trace + telemetry summary artifacts",
     )
-    ap.add_argument("--trace", required=True,
+    ap.add_argument("--trace",
                     help="Chrome trace output path (JSON)")
-    ap.add_argument("--summary", required=True,
+    ap.add_argument("--summary",
                     help="telemetry summary output path (JSON)")
     ap.add_argument("--rows", type=int, default=1 << 16)
+    ap.add_argument("--fleet", metavar="STORE_URL",
+                    help="offline mode: pull + merge every rank's "
+                         "exported snapshot from this store URL "
+                         "instead of running a workload")
     args = ap.parse_args(argv)
+    if args.fleet:
+        doc = fleet_merge(args.fleet)
+        text = json.dumps(doc, indent=2, sort_keys=True)
+        if args.summary:
+            with open(args.summary, "w") as fp:
+                fp.write(text + "\n")
+            print(f"obsdump: fleet summary ({len(doc.get('ranks', []))}"
+                  f" ranks) -> {args.summary}", file=sys.stderr)
+        else:
+            print(text)
+        return 0
+    if not args.trace or not args.summary:
+        ap.error("--trace and --summary are required "
+                 "(unless --fleet is given)")
     summary = run_workload(args.trace, rows=args.rows)
     with open(args.summary, "w") as fp:
         json.dump(summary, fp, indent=2, sort_keys=True)

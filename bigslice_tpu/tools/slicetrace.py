@@ -46,12 +46,25 @@ plus the telemetry-hub sections (utils/telemetry.py):
 Traces from older sessions (no ``inv`` task args) fall back to one
 flat all-ops quartile table.
 
+``--merge`` joins N per-rank trace files (one per SPMD process; the
+fleet plane's ``trace-rank<r>.json`` convention) into ONE correlated
+timeline: each rank is a lane, invocations are matched across files by
+the correlation id their ``bigslice:invocation:N`` instants carry
+(minted once per serve request — identical on every rank by the
+same-driver contract), and the per-rank shuffle/compile/exchange
+contributions render side by side with a fleet rollup. Rank identity
+comes from the ``bigslice:sessionStart`` instant's ``rank`` field,
+falling back to a ``rank<k>`` filename component, then file order.
+
 Usage: python -m bigslice_tpu.tools.slicetrace TRACE.json
+       python -m bigslice_tpu.tools.slicetrace --merge R0.json R1.json ...
 """
 
 from __future__ import annotations
 
 import json
+import os
+import re
 import sys
 from typing import Dict, List
 
@@ -482,13 +495,267 @@ def analyze(path: str) -> str:
     return "\n".join(out)
 
 
+def _rank_of(path: str, doc: dict, fallback: int) -> int:
+    """Rank identity of one trace file: the ``bigslice:sessionStart``
+    instant's ``rank`` field (stamped only on multi-process sessions),
+    else a ``rank<k>`` component in the filename (the fleet plane's
+    ``trace-rank<r>.json`` convention), else the file's position on the
+    command line."""
+    for ev in doc.get("traceEvents", []):
+        if (ev.get("ph") == "i"
+                and str(ev.get("name", "")) == "bigslice:sessionStart"):
+            rank = ev.get("args", {}).get("rank")
+            if rank is not None:
+                return int(rank)
+            break
+    m = re.search(r"rank(\d+)", os.path.basename(path))
+    if m:
+        return int(m.group(1))
+    return fallback
+
+
+def _scan_rank(doc: dict):
+    """One rank's trace, bucketed the same way ``analyze`` buckets a
+    single file: (tasks_by_inv, summaries_by_inv, telem_by_inv)."""
+    tasks: Dict[object, List[dict]] = {}
+    summaries: Dict[object, dict] = {}
+    telem: Dict[object, Dict[str, List[dict]]] = {}
+    for ev in doc.get("traceEvents", []):
+        if ev.get("ph") == "X":
+            tasks.setdefault(
+                ev.get("args", {}).get("inv"), []
+            ).append(ev)
+        elif ev.get("ph") == "i":
+            args = ev.get("args", {})
+            name = str(ev.get("name", ""))
+            if name.startswith("bigslice:invocation:"):
+                summaries[args.get("inv")] = args
+            elif name == "bigslice:shuffleSizes":
+                telem.setdefault(args.get("inv"), {}).setdefault(
+                    "skew", []
+                ).append(ev)
+            elif name == "bigslice:compile":
+                telem.setdefault(args.get("inv"), {}).setdefault(
+                    "compile", []
+                ).append(ev)
+            elif name == "bigslice:exchange":
+                telem.setdefault(args.get("inv"), {}).setdefault(
+                    "exchange", []
+                ).append(ev)
+    return tasks, summaries, telem
+
+
+def _fleet_skew_rows(events) -> List[int]:
+    """Sum one rank's shuffleSizes contributions into a per-partition
+    row vector. Each instant carries THIS CALL's rows (with optional
+    global ``indices`` placement — the multi-process addressable-shard
+    path), so summing every event reconstructs the rank's totals."""
+    vec: List[int] = []
+    for ev in events:
+        a = ev.get("args", {})
+        rows = a.get("rows")
+        if not rows:
+            continue
+        indices = a.get("indices")
+        if indices is None or len(indices) != len(rows):
+            indices = list(range(len(rows)))
+        top = max(indices) + 1
+        if top > len(vec):
+            vec.extend([0] * (top - len(vec)))
+        for i, r in zip(indices, rows):
+            vec[i] += int(r or 0)
+    return vec
+
+
+def analyze_merged(paths: List[str]) -> str:
+    """Join N per-rank trace files into one correlated fleet timeline:
+    rank lanes per invocation, cross-rank skew rollup, and per-rank
+    compile/exchange attribution side by side. Invocations correlate
+    by the ``corr`` id their ``bigslice:invocation:N`` instants carry
+    (identical on every rank by the SPMD same-driver contract),
+    falling back to the inv index for pre-corr traces."""
+    ranks: Dict[int, dict] = {}
+    for k, path in enumerate(paths):
+        with open(path) as fp:
+            doc = json.load(fp)
+        rank = _rank_of(path, doc, k)
+        tasks, summaries, telem = _scan_rank(doc)
+        ranks[rank] = {
+            "path": path, "tasks": tasks, "summaries": summaries,
+            "telem": telem,
+        }
+    out = [f"fleet: {len(ranks)} rank trace(s) merged"]
+    for rank in sorted(ranks):
+        out.append(f"  rank {rank}  {ranks[rank]['path']}")
+    out.append("")
+    # Correlate invocations across ranks: corr id when present (the
+    # serve plane mints one per request; Session.run defaults invN),
+    # else the bare inv index.
+    groups: Dict[object, Dict[int, object]] = {}
+    order: List[object] = []
+    for rank in sorted(ranks):
+        r = ranks[rank]
+        invs = sorted(
+            i for i in set(r["tasks"]) | set(r["telem"])
+            | set(r["summaries"]) if i is not None
+        )
+        for inv in invs:
+            corr = r["summaries"].get(inv, {}).get("corr") or inv
+            if corr not in groups:
+                groups[corr] = {}
+                order.append(corr)
+            groups[corr][rank] = inv
+    for corr in order:
+        members = groups[corr]
+        # Label the section by the lowest participating rank's inv
+        # index (identical across ranks under the same-driver contract).
+        inv0 = members[min(members)]
+        summary = ranks[min(members)]["summaries"].get(inv0, {})
+        out.append(f"# inv{inv0}:summary (corr={corr}, "
+                   f"ranks={sorted(members)})")
+        out.append(f"  location  {summary.get('location', '?')}")
+        if summary.get("args"):
+            out.append(f"  args      {summary['args']}")
+        out.append(f"# inv{inv0}:lanes (per-rank op timeline)")
+        out.append(f"  {'rank':>4} {'op':<28} {'n':>5} {'start_ms':>10} "
+                   f"{'span_ms':>10} {'total_ms':>10}")
+        for rank in sorted(members):
+            evs = ranks[rank]["tasks"].get(members[rank], [])
+            for r in _op_rows(evs):
+                out.append(
+                    f"  {rank:>4} {r['op'][:28]:<28} {r['n']:>5} "
+                    f"{r['start']:>10.2f} {r['span']:>10.2f} "
+                    f"{sum(r['durs']):>10.2f}"
+                )
+        _print_fleet_skew(out, inv0, ranks, members)
+        _print_fleet_compile(out, inv0, ranks, members)
+        _print_fleet_exchange(out, inv0, ranks, members)
+        out.append("")
+    return "\n".join(out)
+
+
+def _print_fleet_skew(out: List[str], inv, ranks, members):
+    """Cross-rank shuffle skew: each rank's contribution vector plus
+    the fleet rollup (elementwise sum across ranks — by construction
+    this equals what a single-process run of the same pipeline would
+    record, since every rank reports its addressable shards at their
+    global partition offsets)."""
+    per_op: Dict[str, Dict[int, List[int]]] = {}
+    for rank in sorted(members):
+        telem = ranks[rank]["telem"].get(members[rank], {})
+        by_op: Dict[str, List[dict]] = {}
+        for ev in telem.get("skew", ()):
+            op = ev.get("args", {}).get("op")
+            if op:
+                by_op.setdefault(op, []).append(ev)
+        for op, evs in by_op.items():
+            vec = _fleet_skew_rows(evs)
+            if vec:
+                per_op.setdefault(op, {})[rank] = vec
+    if not per_op:
+        return
+    from bigslice_tpu.utils.telemetry import TelemetryHub
+
+    out.append(f"# inv{inv}:skew (fleet rollup; per-rank rows summed "
+               f"at global partition offsets)")
+    out.append(f"  {'op':<28} {'lane':>6} {'rows':>10} {'max':>9} "
+               f"{'ratio':>7} {'hot':>4}")
+    for op, by_rank in sorted(per_op.items()):
+        width = max(len(v) for v in by_rank.values())
+        merged = [0] * width
+        for vec in by_rank.values():
+            for i, r in enumerate(vec):
+                merged[i] += r
+        for rank in sorted(by_rank):
+            vec = by_rank[rank]
+            ratio, hot, _, total = TelemetryHub._skew_of(vec)
+            out.append(
+                f"  {op[:28]:<28} {rank:>6} {total:>10} "
+                f"{max(vec):>9} {ratio:>7.2f} {hot:>4}"
+            )
+        ratio, hot, _, total = TelemetryHub._skew_of(merged)
+        out.append(
+            f"  {op[:28]:<28} {'fleet':>6} {total:>10} "
+            f"{max(merged):>9} {ratio:>7.2f} {hot:>4}"
+        )
+
+
+def _print_fleet_compile(out: List[str], inv, ranks, members):
+    """Per-rank compile attribution side by side — with the AOT seam
+    live on every rank, identical counts per rank are the expected
+    signature (deterministic compilation); divergence is the signal."""
+    rows = []
+    for rank in sorted(members):
+        telem = ranks[rank]["telem"].get(members[rank], {})
+        agg: Dict[str, dict] = {}
+        for ev in telem.get("compile", ()):
+            a = ev.get("args", {})
+            d = agg.setdefault(a.get("op", "?"),
+                               {"n": 0, "ms": 0.0, "kinds": set()})
+            d["n"] += 1
+            d["ms"] += a.get("ms", 0.0) or 0.0
+            if a.get("kind"):
+                d["kinds"].add(a["kind"])
+        for op, d in sorted(agg.items()):
+            rows.append((rank, op, d))
+    if not rows:
+        return
+    out.append(f"# inv{inv}:compile (per-rank XLA compile attribution)")
+    out.append(f"  {'rank':>4} {'op':<28} {'n':>4} {'wall_ms':>10}  "
+               f"kinds")
+    for rank, op, d in rows:
+        out.append(
+            f"  {rank:>4} {op[:28]:<28} {d['n']:>4} {d['ms']:>10.1f}  "
+            f"{','.join(sorted(d['kinds'])) or '-'}"
+        )
+
+
+def _print_fleet_exchange(out: List[str], inv, ranks, members):
+    """Per-rank exchange attribution (collective messages by axis)."""
+    rows = []
+    for rank in sorted(members):
+        telem = ranks[rank]["telem"].get(members[rank], {})
+        agg: Dict[str, dict] = {}
+        for ev in telem.get("exchange", ()):
+            a = ev.get("args", {})
+            d = agg.setdefault(a.get("op", "?"),
+                               {"dcn_m": 0, "dcn_b": 0, "ici_m": 0,
+                                "ici_b": 0})
+            d["dcn_m"] += a.get("dcn_messages", 0) or 0
+            d["dcn_b"] += a.get("dcn_bytes", 0) or 0
+            d["ici_m"] += a.get("ici_messages", 0) or 0
+            d["ici_b"] += a.get("ici_bytes", 0) or 0
+        for op, d in sorted(agg.items()):
+            rows.append((rank, op, d))
+    if not rows:
+        return
+    out.append(f"# inv{inv}:exchange (per-rank collective messages)")
+    out.append(f"  {'rank':>4} {'op':<28} {'dcn_msg':>8} {'dcn_MB':>8} "
+               f"{'ici_msg':>8} {'ici_MB':>8}")
+    for rank, op, d in rows:
+        out.append(
+            f"  {rank:>4} {op[:28]:<28} {d['dcn_m']:>8} "
+            f"{d['dcn_b'] / 1e6:>8.2f} {d['ici_m']:>8} "
+            f"{d['ici_b'] / 1e6:>8.2f}"
+        )
+
+
 def main(argv=None):
     argv = argv if argv is not None else sys.argv[1:]
     if not argv:
-        print("usage: python -m bigslice_tpu.tools.slicetrace TRACE.json",
+        print("usage: python -m bigslice_tpu.tools.slicetrace TRACE.json\n"
+              "       python -m bigslice_tpu.tools.slicetrace --merge "
+              "R0.json R1.json ...",
               file=sys.stderr)
         return 2
     try:
+        if argv[0] == "--merge":
+            if not argv[1:]:
+                print("--merge needs at least one trace file",
+                      file=sys.stderr)
+                return 2
+            print(analyze_merged(argv[1:]))
+            return 0
         for path in argv:
             print(analyze(path))
     except BrokenPipeError:  # `slicetrace t.json | head` is fine

@@ -142,6 +142,8 @@ class DebugServer:
             "/debug/trace   chrome trace (json)",
             "/debug/resources  HBM/RSS/combiner gauges (json)",
             "/debug/metrics  telemetry in Prometheus text format",
+            "/debug/fleet   cross-rank merged telemetry (json;"
+            " ?format=prom for rank-labelled series)",
             "/debug/device  device-plane summary: compile/cost/memory,"
             " HBM, donation (json)",
             "/debug/profile?seconds=N  windowed jax profiler trace of"
@@ -172,6 +174,8 @@ class DebugServer:
             hub = getattr(session, "telemetry", None)
             text = hub.prometheus_text() if hub else ""
             handler._send(200, "text/plain; version=0.0.4", text)
+        elif path == "/debug/fleet":
+            self._fleet(handler, parse_qs(parsed.query))
         elif path == "/debug/device":
             hub = getattr(session, "telemetry", None)
             dev = getattr(hub, "device", None)
@@ -191,6 +195,40 @@ class DebugServer:
     def handle_post(self, handler, parsed) -> bool:
         """No POST routes on the pure debug surface."""
         return False
+
+    def _fleet(self, handler, query):
+        """The fleet plane's scrape surface: the cross-rank merged
+        telemetry summary (json), or rank-labelled ``bigslice_*{rank=}``
+        Prometheus series with ``?format=prom``. Degrades to this
+        process's own 1-rank fleet when no fleet exporter is configured
+        — the endpoint shape never depends on deployment mode."""
+        session = self.session
+        fmt = (query.get("format") or ["json"])[0]
+        if fmt in ("prom", "prometheus"):
+            from bigslice_tpu.utils import fleettelemetry as fleet_mod
+
+            fleet = getattr(session, "fleet", None)
+            hub = getattr(session, "telemetry", None)
+            try:
+                if fleet is not None:
+                    snaps = fleet.pull()
+                elif hub is not None:
+                    snaps = [hub.snapshot()]
+                else:
+                    snaps = []
+                text = fleet_mod.prometheus_fleet_text(snaps)
+            except Exception as e:  # noqa: BLE001 — report, not crash
+                handler._send(500, "text/plain",
+                              f"fleet scrape failed: {e!r}\n")
+                return
+            handler._send(200, "text/plain; version=0.0.4", text)
+            return
+        summary_fn = getattr(session, "telemetry_summary", None)
+        try:
+            doc = summary_fn(scope="fleet") if summary_fn else {}
+        except Exception:
+            doc = {}
+        handler._send_json(200, doc)
 
     def _profile(self, handler, query):
         """Windowed on-demand profiling: blocks this request thread

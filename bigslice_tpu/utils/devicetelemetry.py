@@ -866,6 +866,54 @@ class DeviceTelemetry:
         }
         return out
 
+    def snapshot(self) -> dict:
+        """The device plane's serializable mergeable snapshot (the
+        ``device`` section of ``TelemetryHub.snapshot()``): flat per-op
+        counters that add across ranks, plus the HBM watermark rollup
+        that max-merges. Per-program detail lists and plan free-text
+        stay local — they don't merge and the fleet plane doesn't need
+        them."""
+        with self._lock:
+            ops: Dict[str, dict] = {}
+            for op, rec in self._ops.items():
+                ops[op] = {
+                    "inv": rec.inv,
+                    "compiles": rec.compiles,
+                    "cache_hits": rec.cache_hits,
+                    "cross_session_hits": rec.cross_session_hits,
+                    "fallbacks": rec.fallbacks,
+                    "compile_s": rec.compile_wall_s,
+                    "flops": rec.flops,
+                    "bytes_accessed": rec.bytes_accessed,
+                    "donation_expected_bytes":
+                        rec.donation_expected_bytes,
+                    "donation_aliased_bytes":
+                        rec.donation_aliased_bytes,
+                    "donation_buffers": rec.donation_buffers,
+                    "donation_aliased_buffers":
+                        rec.donation_aliased_buffers,
+                    "exchange_waves": rec.exchange_waves,
+                    "dcn_messages": rec.dcn_messages,
+                    "dcn_bytes": rec.dcn_bytes,
+                    "ici_messages": rec.ici_messages,
+                    "ici_bytes": rec.ici_bytes,
+                    "flat_dcn_messages": rec.flat_dcn_messages,
+                    "flat_dcn_bytes": rec.flat_dcn_bytes,
+                    "plan_counts": dict(rec.plan_counts),
+                    "spill_bytes": rec.spill_bytes,
+                    "spill_rows": rec.spill_rows,
+                    "spill_partitions": rec.spill_partitions,
+                }
+            hbm: dict = {
+                "peak_bytes": self._hbm_peak_bytes,
+                "samples": len(self._hbm),
+            }
+            if self._hbm_limit_bytes:
+                hbm["limit_bytes"] = self._hbm_limit_bytes
+            if self._hbm_source:
+                hbm["source"] = self._hbm_source
+        return {"ops": ops, "hbm": hbm}
+
     def prometheus_lines(self, metric, line) -> None:
         """Append this recorder's gauges/counters through the hub's
         Prometheus helpers (metric(name, help, type) / line(name,

@@ -105,6 +105,20 @@ def _escape_label(v: str) -> str:
             .replace("\n", "\\n"))
 
 
+def _process_rank() -> Optional[int]:
+    """Rank tag for per-process artifacts: the SPMD process index when
+    this is a multi-process gang, else None — single-process artifact
+    names (and docs) stay byte-stable."""
+    try:
+        import jax
+
+        if int(jax.process_count()) > 1:
+            return int(jax.process_index())
+    except Exception:
+        pass
+    return None
+
+
 class _OpRecord:
     """Per-op accumulation (one instance per distinct op name; iterative
     drivers re-invoke under fresh ``#N``-suffixed names, so an op key is
@@ -376,38 +390,25 @@ class TelemetryHub:
             return None
         key = inv
         try:
-            with self._flight_lock:
-                ring = list(self._flight)
             with self._lock:
                 if key in self._flight_dumped:
                     return self._flight_dumped[key]
-                events = [
-                    {"ts": ts, "name": name, **fields}
-                    for ts, name, fields in ring
-                    if inv is None or fields.get("inv") in (None, inv)
-                ]
-                states: Dict[str, int] = {}
-                for (_, st), n in self._state_counts.items():
-                    states[st] = states.get(st, 0) + n
-            doc = {
-                "inv": inv,
-                "reason": reason,
-                "ts": time.time(),
-                "task_states": states,
-                "events": events,
-            }
-            plan = faultinject.active_plan()
-            if plan is not None:
-                doc["chaos"] = plan.snapshot()
+            doc = self.flight_doc(inv=inv, reason=reason)
             import json
             import os
 
             os.makedirs(dirname, exist_ok=True)
-            path = os.path.join(
-                dirname,
-                f"flightrec-{inv if inv is not None else 'session'}"
-                f".json",
-            )
+            stem = f"flightrec-{inv if inv is not None else 'session'}"
+            rank = doc.get("rank")
+            if rank is not None:
+                # Multi-process gang: every rank dumps its own ring
+                # (same dir may be shared storage) — the rank suffix
+                # keeps them from clobbering each other, and the
+                # coordinator's post-mortem collation
+                # (fleettelemetry.FleetExporter.collate_flights) joins
+                # them into one bundle.
+                stem += f"-rank{rank}"
+            path = os.path.join(dirname, stem + ".json")
             with open(path, "w") as fp:
                 json.dump(doc, fp, indent=1, default=str)
             with self._lock:
@@ -416,31 +417,86 @@ class TelemetryHub:
         except Exception:  # telemetry must never break the run
             return None
 
+    def flight_doc(self, inv: Optional[int] = None,
+                   reason: str = "") -> dict:
+        """The flight-recorder document (event ring filtered to
+        ``inv`` when given, task-state census, active chaos plan),
+        rank-tagged on multi-process gangs — what
+        ``dump_flight_record`` writes locally and what the fleet
+        exporter pushes through the store for coordinator collation
+        into one post-mortem bundle."""
+        with self._flight_lock:
+            ring = list(self._flight)
+        with self._lock:
+            events = [
+                {"ts": ts, "name": name, **fields}
+                for ts, name, fields in ring
+                if inv is None or fields.get("inv") in (None, inv)
+            ]
+            states: Dict[str, int] = {}
+            for (_, st), n in self._state_counts.items():
+                states[st] = states.get(st, 0) + n
+        doc = {
+            "inv": inv,
+            "reason": reason,
+            "ts": time.time(),
+            "task_states": states,
+            "events": events,
+        }
+        rank = _process_rank()
+        if rank is not None:
+            doc["rank"] = rank
+        plan = faultinject.active_plan()
+        if plan is not None:
+            doc["chaos"] = plan.snapshot()
+        return doc
+
     # -- executor seams ---------------------------------------------------
 
     def record_shuffle(self, op: str, inv: Optional[int],
-                       rows, nbytes=None) -> None:
+                       rows, nbytes=None, indices=None,
+                       rank: Optional[int] = None) -> None:
         """One producer's (or one whole group's) per-partition sizes at
         a shuffle boundary. Contributions accumulate elementwise per op,
         so per-producer host-tier calls and single whole-group mesh
-        calls land in the same per-op partition-size vector."""
+        calls land in the same per-op partition-size vector.
+
+        ``indices`` places the contributions at explicit *global*
+        partition positions — the multi-process SPMD path, where each
+        rank only reads its addressable shards of the count array and
+        reports them at their global offsets. Only the provided
+        entries are observed by the size histogram (the unaddressable
+        rest of the vector stays untouched zeros), so a post-hoc
+        cross-rank merge of per-rank snapshots reconstructs exactly
+        the single-process vector and histogram. ``rank`` tags the
+        emitted event for trace attribution."""
         rows = [max(0, int(r)) for r in rows]
         if not rows:
             return
         if nbytes is None:
             nbytes = [0] * len(rows)
-        nbytes = [max(0, int(b)) for b in nbytes]
+        nbytes = [max(0, int(b)) for b in nbytes][:len(rows)]
+        if indices is not None:
+            indices = [int(i) for i in indices]
+            if len(indices) != len(rows) or any(i < 0
+                                                for i in indices):
+                return  # malformed caller: drop, don't corrupt
+            top = max(indices) + 1
+        else:
+            top = len(rows)
         with self._lock:
             rec = self._op(op, inv)
-            if len(rec.part_rows) < len(rows):
+            if len(rec.part_rows) < top:
                 rec.part_rows.extend(
-                    [0] * (len(rows) - len(rec.part_rows)))
+                    [0] * (top - len(rec.part_rows)))
                 rec.part_bytes.extend(
-                    [0] * (len(rows) - len(rec.part_bytes)))
+                    [0] * (top - len(rec.part_bytes)))
             for i, r in enumerate(rows):
-                rec.part_rows[i] += r
+                rec.part_rows[indices[i] if indices is not None
+                              else i] += r
             for i, b in enumerate(nbytes):
-                rec.part_bytes[i] += b
+                rec.part_bytes[indices[i] if indices is not None
+                               else i] += b
             rec.shuffle_boundaries += 1
             for r in rows:  # histogram observes per-shard sizes
                 for bi, le in enumerate(ROWS_BUCKETS):
@@ -467,6 +523,9 @@ class TelemetryHub:
         self._emit(
             "bigslice:shuffleSizes", op=op, inv=inv,
             rows=rows if len(rows) <= 64 else None,
+            indices=(indices if indices is not None
+                     and len(indices) <= 64 else None),
+            rank=rank,
             total_rows=total, max_rows=max_rows, median_rows=median,
             ratio=round(ratio, 3), max_shard=max_shard,
             flagged=flagged,
@@ -740,6 +799,74 @@ class TelemetryHub:
             except Exception:
                 out["adaptive"] = {}
         return out
+
+    def snapshot(self, rank: Optional[int] = None,
+                 nranks: Optional[int] = None) -> dict:
+        """This process's telemetry as a serializable, rank-tagged,
+        *mergeable* snapshot — the fleet plane's exchange format
+        (utils/fleettelemetry.py). Unlike ``summary()`` (rendered for
+        humans, quantiles from raw sample lists), every field here
+        merges losslessly across ranks: counters add, per-partition
+        vectors add elementwise, maxima take max, and task/recovery
+        durations ride fixed-bin histograms
+        (``fleettelemetry.DUR_BUCKETS_S``) whose merged quantiles are
+        within one bin of the raw-sample values."""
+        from bigslice_tpu.utils import fleettelemetry as fleet_mod
+
+        if rank is None:
+            rank = fleet_mod.process_rank()
+        if nranks is None:
+            nranks = fleet_mod.process_count()
+        with self._lock:
+            ops: Dict[str, dict] = {}
+            for op, rec in self._ops.items():
+                ops[op] = {
+                    "inv": rec.inv,
+                    "durations": fleet_mod.duration_hist(
+                        rec.durations),
+                    "stragglers": list(rec.stragglers)[:16],
+                    "part_rows": list(rec.part_rows),
+                    "part_bytes": list(rec.part_bytes),
+                    "boundaries": rec.shuffle_boundaries,
+                    "rows_hist": list(rec.rows_hist),
+                    "rows_hist_sum": rec.rows_hist_sum,
+                    "rows_hist_count": rec.rows_hist_count,
+                    "staging_s": rec.staging_s,
+                    "exposed_s": rec.exposed_s,
+                    "compute_s": rec.compute_s,
+                    "staged_waves": rec.staged_waves,
+                    "max_wave": rec.max_wave,
+                    "phase_counts": dict(rec.phase_counts),
+                    "stage_phases": dict(rec.stage_phases),
+                }
+            states: Dict[str, int] = {}
+            for (_, st), n in self._state_counts.items():
+                states[st] = states.get(st, 0) + n
+            recovery = {
+                "recovered": dict(self._recovered),
+                "fatal": dict(self._recovery_fatal),
+                "pending": len(self._recovery_pending),
+                "latency": fleet_mod.duration_hist(
+                    [v for ls in self._recovery_lat.values()
+                     for v in ls]
+                ),
+            }
+            drain_timeouts = self._drain_timeouts
+        doc = {
+            "schema": fleet_mod.SNAPSHOT_SCHEMA,
+            "rank": int(rank),
+            "nranks": int(nranks),
+            "ts": time.time(),
+            "ops": ops,
+            "task_states": states,
+            "recovery": recovery,
+            "drain_timeouts": drain_timeouts,
+        }
+        try:
+            doc["device"] = self.device.snapshot()
+        except Exception:  # telemetry must never break the run
+            doc["device"] = {}
+        return doc
 
     @staticmethod
     def _lat_stats(lats: List[float]) -> dict:

@@ -1,0 +1,382 @@
+"""Fleet-telemetry tests: mergeable snapshots (fixed-bin histograms,
+elementwise vectors), the store-mediated export/pull/merge cycle, the
+chicken-bit disable contract, rank-suffixed flight records, cross-rank
+postmortem collation, the offline obsdump --fleet mode, and the
+slicetrace --merge rank-lane renderer (utils/fleettelemetry.py)."""
+
+import json
+import os
+import re
+
+import numpy as np
+import pytest
+
+import bigslice_tpu as bs
+from bigslice_tpu.exec.session import Session
+from bigslice_tpu.utils import fleettelemetry as fleet_mod
+from bigslice_tpu.utils import telemetry as telemetry_mod
+
+
+def _mesh_session(**kwargs):
+    import jax
+    from jax.sharding import Mesh
+
+    from bigslice_tpu.exec.meshexec import MeshExecutor
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("shards",))
+    return Session(executor=MeshExecutor(mesh), **kwargs)
+
+
+def _bucket_of(v: float) -> int:
+    for i, edge in enumerate(fleet_mod.DUR_BUCKETS_S):
+        if v <= edge:
+            return i
+    return len(fleet_mod.DUR_BUCKETS_S)
+
+
+# ------------------------------------------------- mergeable histograms
+
+def test_merged_quantile_within_one_bin_of_exact():
+    """Acceptance bound: quantiles from rank-merged histograms land in
+    the SAME fixed bin as the exact quantile over the concatenated raw
+    durations — the error a fixed-bin mergeable sketch admits."""
+    rng = np.random.RandomState(5)
+    rank0 = list(np.abs(rng.lognormal(-4.0, 1.2, 300)))
+    rank1 = list(np.abs(rng.lognormal(-3.5, 1.0, 200)))
+    merged = fleet_mod.merge_hist(
+        fleet_mod.duration_hist(rank0), fleet_mod.duration_hist(rank1)
+    )
+    assert merged["count"] == 500
+    assert merged["sum"] == pytest.approx(sum(rank0) + sum(rank1))
+    both = sorted(rank0 + rank1)
+    for p in (0.5, 0.9, 0.99):
+        exact = telemetry_mod.quantile(both, p)
+        est = fleet_mod.hist_quantile(merged, p)
+        assert _bucket_of(est) == _bucket_of(exact), (p, est, exact)
+    # The max is carried exactly (not binned).
+    assert fleet_mod.hist_quantile(merged, 1.0) == pytest.approx(
+        max(both)
+    )
+
+
+def test_snapshot_json_round_trip_and_merge():
+    hub = telemetry_mod.TelemetryHub()
+    hub.record_shuffle("op1", 1, [10, 20, 30], [80, 160, 240])
+    with hub._lock:
+        hub._op("op1", 1).durations.extend([0.01, 0.02, 0.3])
+    snap = hub.snapshot(rank=0, nranks=1)
+    assert snap["schema"] == fleet_mod.SNAPSHOT_SCHEMA
+    wire = json.loads(json.dumps(snap))  # store round-trip
+    fleet = fleet_mod.merge_snapshots([wire])
+    assert fleet["scope"] == "fleet"
+    assert fleet["ranks"] == [0]
+    assert fleet["ops"]["op1"]["skew"]["rows"] == [10, 20, 30]
+    assert fleet["ops"]["op1"]["tasks"]["n"] == 3
+
+
+def test_two_rank_merge_equals_single_process():
+    """The multiprocess contract: two ranks each recording their
+    addressable slice (global ``indices`` placement) merge to exactly
+    the vector one process recording everything would produce — and
+    per_rank_rows keeps the per-rank attribution."""
+    single = telemetry_mod.TelemetryHub()
+    single.record_shuffle("red", 1, [100, 12, 3, 9],
+                          [800, 96, 24, 72])
+    r0 = telemetry_mod.TelemetryHub()
+    r0.record_shuffle("red", 1, [100, 12], [800, 96],
+                      indices=[0, 1], rank=0)
+    r1 = telemetry_mod.TelemetryHub()
+    r1.record_shuffle("red", 1, [3, 9], [24, 72],
+                      indices=[2, 3], rank=1)
+    durs = [0.004, 0.008, 0.040, 0.120]
+    with single._lock:
+        single._op("red", 1).durations.extend(durs)
+    with r0._lock:
+        r0._op("red", 1).durations.extend(durs[:2])
+    with r1._lock:
+        r1._op("red", 1).durations.extend(durs[2:])
+    ref = fleet_mod.merge_snapshots([single.snapshot(rank=0, nranks=1)])
+    fleet = fleet_mod.merge_snapshots([
+        r0.snapshot(rank=0, nranks=2), r1.snapshot(rank=1, nranks=2),
+    ])
+    assert fleet["ranks"] == [0, 1]
+    ref_skew, skew = (d["ops"]["red"]["skew"] for d in (ref, fleet))
+    assert skew["rows"] == ref_skew["rows"] == [100, 12, 3, 9]
+    assert skew["bytes"] == ref_skew["bytes"]
+    assert skew["ratio"] == ref_skew["ratio"]
+    assert skew["max_shard"] == ref_skew["max_shard"] == 0
+    assert skew["per_rank_rows"] == {"0": 112, "1": 12}
+    # Same durations → identical merged histogram (sum up to float
+    # association order) → identical quantiles (the 1-rank reference
+    # is the single-process run).
+    h, ref_h = (d["ops"]["red"]["tasks"]["hist"] for d in (fleet, ref))
+    assert h["buckets"] == ref_h["buckets"]
+    assert h["count"] == ref_h["count"] and h["max"] == ref_h["max"]
+    assert h["sum"] == pytest.approx(ref_h["sum"])
+    assert fleet["ops"]["red"]["tasks"]["p50_s"] == \
+        ref["ops"]["red"]["tasks"]["p50_s"]
+
+
+def test_record_shuffle_indices_observe_only_provided_rows():
+    """Global placement must not zero-inflate the per-partition row
+    distribution: a rank contributing 2 partitions of a 64-wide space
+    observes 2 samples, not 64."""
+    hub = telemetry_mod.TelemetryHub()
+    hub.record_shuffle("op", 1, [7, 9], indices=[5, 63], rank=0)
+    snap = hub.snapshot(rank=0, nranks=2)
+    rec = snap["ops"]["op"]
+    assert len(rec["part_rows"]) == 64
+    assert rec["part_rows"][5] == 7 and rec["part_rows"][63] == 9
+    assert sum(rec["part_rows"]) == 16
+    assert rec["rows_hist_count"] == 2
+    # Malformed indices are dropped whole, not partially applied.
+    hub.record_shuffle("op", 1, [1, 2], indices=[0], rank=0)
+    assert sum(hub.snapshot()["ops"]["op"]["part_rows"]) == 16
+
+
+# ------------------------------------------- store-mediated export/merge
+
+def _hub_with_rank_data(rank: int) -> telemetry_mod.TelemetryHub:
+    hub = telemetry_mod.TelemetryHub()
+    hub.record_shuffle("red", 1, [10 + rank, 5], [80, 40],
+                       indices=[2 * rank, 2 * rank + 1], rank=rank)
+    with hub._lock:
+        hub._op("red", 1).durations.extend([0.01 * (rank + 1)] * 3)
+    return hub
+
+
+def test_fleet_exporter_export_pull_merge(tmp_path):
+    url = str(tmp_path)
+    ex0 = fleet_mod.FleetExporter(_hub_with_rank_data(0), url,
+                                  rank=0, nranks=2, period_s=0)
+    ex1 = fleet_mod.FleetExporter(_hub_with_rank_data(1), url,
+                                  rank=1, nranks=2, period_s=0)
+    assert ex0.export() is not None
+    assert ex1.export() is not None
+    snaps = ex0.pull(wait_for_all=True, timeout_s=5)
+    assert [s["rank"] for s in snaps] == [0, 1]
+    fleet = ex0.fleet_summary()
+    assert fleet["ranks"] == [0, 1]
+    assert fleet["ops"]["red"]["skew"]["rows"] == [10, 5, 11, 5]
+    assert set(fleet["per_rank"]) == {"0", "1"}
+    # close(): rank 0 writes the merged fleet.json into the store.
+    ex0.close()
+    ex1.close()
+    store = fleet_mod._aux_store(url)
+    merged = json.loads(store.get_aux(fleet_mod.MERGED_NAME).decode())
+    assert merged["ranks"] == [0, 1]
+    assert merged["nranks"] == 2
+
+
+def test_obsdump_fleet_offline_merge(tmp_path, capsys):
+    from bigslice_tpu.tools import obsdump
+
+    url = str(tmp_path)
+    for rank in (0, 1):
+        fleet_mod.FleetExporter(_hub_with_rank_data(rank), url,
+                                rank=rank, nranks=2,
+                                period_s=0).export()
+    out = str(tmp_path / "fleet-summary.json")
+    assert obsdump.main(["--fleet", url, "--summary", out]) == 0
+    with open(out) as fp:
+        doc = json.load(fp)
+    assert doc["scope"] == "fleet" and doc["ranks"] == [0, 1]
+    # Without --summary the document prints to stdout.
+    assert obsdump.main(["--fleet", url]) == 0
+    assert json.loads(capsys.readouterr().out)["ranks"] == [0, 1]
+    with pytest.raises(SystemExit):
+        obsdump.main(["--fleet", str(tmp_path / "empty")])
+
+
+def test_memory_store_aux_blobs():
+    from bigslice_tpu.exec.store import MemoryStore
+
+    st = MemoryStore()
+    assert st.get_aux("x.json") is None
+    st.put_aux("x.json", b"{}")
+    assert st.get_aux("x.json") == b"{}"
+
+
+# ------------------------------------------------ session-level wiring
+
+def test_session_fleet_dir_exports_and_merges(tmp_path):
+    sess = _mesh_session(fleet_dir=str(tmp_path))
+    assert sess.fleet is not None
+    keys = (np.arange(4096, dtype=np.int64) % 97).astype(np.int32)
+    res = sess.run(bs.Reduce(
+        bs.Const(4, keys, np.ones(len(keys), np.int32)),
+        lambda a, b: a + b))
+    # The default corr id is inv<N> off the process-global invocation
+    # counter — exact N depends on what ran before in this process.
+    assert re.fullmatch(r"inv\d+", res.corr), res.corr
+    single = sess.telemetry_summary()
+    fleet = sess.telemetry_summary(scope="fleet")
+    assert fleet["scope"] == "fleet" and fleet["ranks"] == [0]
+    ops_with_skew = [op for op, e in fleet["ops"].items()
+                     if "skew" in e]
+    assert ops_with_skew
+    for op in ops_with_skew:
+        # 1-rank fleet merge reproduces the session summary's skew.
+        assert fleet["ops"][op]["skew"]["rows"] == \
+            single["ops"][op]["skew"]["rows"]
+        assert fleet["ops"][op]["skew"]["ratio"] == \
+            single["ops"][op]["skew"]["ratio"]
+    sess.shutdown()
+    aux = tmp_path / "aux"
+    names = sorted(p.name for p in aux.iterdir())
+    assert fleet_mod.SNAP_NAME.format(rank=0) in names
+    assert fleet_mod.MERGED_NAME in names
+    with open(aux / fleet_mod.MERGED_NAME) as fp:
+        merged = json.load(fp)
+    assert merged["ranks"] == [0]
+    assert merged["device"]["totals"]["compiles"] >= 0
+
+
+def test_telemetry_disabled_writes_zero_snapshots(tmp_path,
+                                                 monkeypatch):
+    """The chicken bit: BIGSLICE_TELEMETRY=0 disables the WHOLE fleet
+    plane — no exporter, no thread, zero snapshot files written."""
+    monkeypatch.setenv("BIGSLICE_TELEMETRY", "0")
+    sess = Session(fleet_dir=str(tmp_path))
+    assert sess.telemetry is None
+    assert sess.fleet is None
+    res = sess.run(bs.Const(2, np.arange(8, dtype=np.int32)))
+    assert len(sorted(res.rows())) == 8
+    assert sess.telemetry_summary(scope="fleet") == {}
+    sess.shutdown()
+    written = [str(p.relative_to(tmp_path))
+               for p in tmp_path.rglob("*")]
+    assert written == [], written
+
+
+def test_debug_fleet_endpoint(tmp_path):
+    from urllib.request import urlopen
+
+    sess = _mesh_session(fleet_dir=str(tmp_path), debug_port=0)
+    sess.run(bs.Reduce(
+        bs.Const(4, np.arange(1024, dtype=np.int32) % 31,
+                 np.ones(1024, np.int32)),
+        lambda a, b: a + b))
+    base = f"http://127.0.0.1:{sess.debug.port}"
+    doc = json.loads(urlopen(f"{base}/debug/fleet").read())
+    assert doc["scope"] == "fleet" and doc["ranks"] == [0]
+    text = urlopen(f"{base}/debug/fleet?format=prom").read().decode()
+    assert "bigslice_fleet_ranks 1" in text
+    assert 'rank="0"' in text
+    assert "bigslice_task_duration_seconds_bucket" in text
+    sess.shutdown()
+
+
+# ------------------------------------------------- flight records
+
+def test_flight_record_rank_suffix(tmp_path, monkeypatch):
+    monkeypatch.setenv("BIGSLICE_FLIGHTREC_DIR", str(tmp_path))
+    monkeypatch.setattr(telemetry_mod, "_process_rank", lambda: 1)
+    hub = telemetry_mod.TelemetryHub()
+    hub._emit("bigslice:test", op="x", inv=3)
+    path = hub.dump_flight_record(inv=3, reason="boom")
+    assert os.path.basename(path) == "flightrec-3-rank1.json"
+    with open(path) as fp:
+        assert json.load(fp)["rank"] == 1
+
+
+def test_collate_flights_postmortem_bundle(tmp_path):
+    url = str(tmp_path)
+    exps = []
+    for rank in (0, 1):
+        hub = _hub_with_rank_data(rank)
+        ex = fleet_mod.FleetExporter(hub, url, rank=rank, nranks=2,
+                                     period_s=0)
+        ex.export_flight(hub.flight_doc(inv=1, reason=f"boom{rank}"))
+        exps.append(ex)
+    name = exps[0].collate_flights(wait_s=5)
+    assert name == fleet_mod.POSTMORTEM_NAME
+    store = fleet_mod._aux_store(url)
+    bundle = json.loads(store.get_aux(name).decode())
+    assert sorted(bundle["by_rank"]) == ["0", "1"]
+    assert bundle["by_rank"]["1"]["reason"] == "boom1"
+    # Non-coordinator ranks never collate.
+    assert exps[1].collate_flights(wait_s=1) is None
+
+
+# ------------------------------------------------ slicetrace --merge
+
+def _rank_trace(tmp_path, rank: int, part: int):
+    doc = {"traceEvents": [
+        {"ph": "i", "name": "bigslice:sessionStart", "ts": 0,
+         "args": {"rank": rank}},
+        {"ph": "i", "name": "bigslice:invocation:1", "ts": 1,
+         "args": {"inv": 1, "corr": "smoke:1",
+                  "location": "pipe.py:10", "args": "()"}},
+        {"ph": "X", "name": "reduce@pipe.py:10", "ts": 1000 + rank,
+         "dur": 500 + 100 * rank,
+         "args": {"inv": 1, "shard": rank, "shards": 2}},
+        {"ph": "i", "name": "bigslice:shuffleSizes", "ts": 1200,
+         "args": {"op": "reduce@pipe.py:10", "inv": 1,
+                  "rows": [40 + rank], "indices": [part],
+                  "rank": rank}},
+        {"ph": "i", "name": "bigslice:compile", "ts": 1300,
+         "args": {"op": "reduce@pipe.py:10", "inv": 1, "ms": 12.5,
+                  "kind": "compile"}},
+        {"ph": "i", "name": "bigslice:exchange", "ts": 1400,
+         "args": {"op": "reduce@pipe.py:10", "inv": 1,
+                  "ici_messages": 2, "ici_bytes": 4096}},
+    ]}
+    path = tmp_path / f"trace-rank{rank}.json"
+    path.write_text(json.dumps(doc))
+    return str(path)
+
+
+def test_slicetrace_merge_renders_rank_lanes(tmp_path, capsys):
+    from bigslice_tpu.tools import slicetrace
+
+    p0 = _rank_trace(tmp_path, 0, part=0)
+    p1 = _rank_trace(tmp_path, 1, part=1)
+    assert slicetrace.main(["--merge", p0, p1]) == 0
+    out = capsys.readouterr().out
+    assert "fleet: 2 rank trace(s) merged" in out
+    assert "corr=smoke:1" in out and "ranks=[0, 1]" in out
+    assert "inv1:lanes" in out
+    # One lane row per rank for the op.
+    lanes = [ln for ln in out.splitlines()
+             if "reduce@pipe.py:10" in ln and ln.strip()[0] in "01"]
+    assert len(lanes) >= 2
+    # Fleet skew rollup: per-rank rows at global offsets sum to the
+    # merged vector [40, 41].
+    fleet_line = next(ln for ln in out.splitlines()
+                      if "fleet" in ln and "81" in ln)
+    assert fleet_line
+    assert "inv1:compile (per-rank" in out
+    assert "inv1:exchange (per-rank" in out
+
+
+def test_slicetrace_merge_rank_from_filename(tmp_path, capsys):
+    from bigslice_tpu.tools import slicetrace
+
+    # No sessionStart rank field → the rank<k> filename convention.
+    doc = {"traceEvents": [
+        {"ph": "X", "name": "map@x", "ts": 10, "dur": 5,
+         "args": {"inv": 2}},
+        {"ph": "i", "name": "bigslice:invocation:2", "ts": 1,
+         "args": {"inv": 2, "location": "x"}},
+    ]}
+    p = tmp_path / "trace-rank7.json"
+    p.write_text(json.dumps(doc))
+    assert slicetrace.main(["--merge", str(p)]) == 0
+    out = capsys.readouterr().out
+    assert "rank 7" in out
+    assert "ranks=[7]" in out
+
+
+# ------------------------------------------------ prometheus rendering
+
+def test_prometheus_fleet_text_rank_labels():
+    snaps = [_hub_with_rank_data(r).snapshot(rank=r, nranks=2)
+             for r in (0, 1)]
+    text = fleet_mod.prometheus_fleet_text(snaps)
+    assert "bigslice_fleet_ranks 2" in text
+    assert 'bigslice_shuffle_partition_rows_sum{rank="1",op="red"}' \
+        in text
+    assert text.count("bigslice_task_duration_seconds_count") >= 2
+    for ln in text.splitlines():
+        assert "{}" not in ln

@@ -16,6 +16,7 @@ The acceptance criteria this file pins:
 """
 
 import json
+import re
 import threading
 import time
 import urllib.error
@@ -278,6 +279,52 @@ def test_invoke_http_roundtrip(serve_mesh):
     assert doc["num_rows"] == 64
     assert sum(r[1] for r in doc["rows"]) == 8192
     assert doc["latency_s"] > 0
+
+
+def test_invoke_correlation_id_minted_and_echoed(serve_mesh):
+    """Every serve invocation carries a correlation id: minted
+    ``<pipeline>:<seq>`` when the client sends none, echoed verbatim
+    when it does — the cross-rank trace-correlation key."""
+    code, doc = _post(serve_mesh, {"pipeline": "reduce", "args": [64]})
+    assert code == 200
+    assert re.fullmatch(r"reduce:\d+", doc["corr"]), doc["corr"]
+    code, doc2 = _post(serve_mesh, {"pipeline": "reduce", "args": [64],
+                                    "corr": "req-abc123"})
+    assert code == 200 and doc2["corr"] == "req-abc123"
+    # Evaluation errors carry it too (joins failures to traces).
+    code, err = _post(serve_mesh, {"pipeline": "reduce",
+                                   "args": ["bogus"],
+                                   "corr": "req-bad"})
+    assert code == 500 and err["corr"] == "req-bad"
+
+
+def test_correlation_id_lands_in_trace(tmp_path):
+    """End-to-end correlation: request → response corr → the session
+    trace's ``bigslice:invocation:N`` instant — the id slicetrace
+    --merge joins rank timelines on."""
+    import jax
+    from jax.sharding import Mesh
+
+    from bigslice_tpu.exec.meshexec import MeshExecutor
+
+    trace = str(tmp_path / "t.json")
+    mesh = Mesh(np.array(jax.devices()[:4]), ("shards",))
+    sess = Session(executor=MeshExecutor(mesh), trace_path=trace)
+    srv = ServeServer(sess, port=0, slots=1, queue_depth=4)
+    srv.register("wc", lambda: bs.Reduce(
+        bs.Const(4, np.arange(256, dtype=np.int32) % 7,
+                 np.ones(256, np.int32)), _add))
+    code, doc = srv.invoke_request({"pipeline": "wc"})
+    assert code == 200, doc
+    corr = doc["corr"]
+    sess.shutdown()
+    with open(trace) as fp:
+        events = json.load(fp)["traceEvents"]
+    tagged = [ev for ev in events
+              if str(ev.get("name", "")).startswith(
+                  "bigslice:invocation:")
+              and ev.get("args", {}).get("corr") == corr]
+    assert tagged, corr
 
 
 def test_invoke_unknown_pipeline_404(serve_mesh):
