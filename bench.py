@@ -27,6 +27,12 @@ Modes (argv[1], default "reduce"):
                   cold first-request latency across a FRESH Session
                   (zero XLA compiles via the cross-Session program
                   cache — enforced), program-cache hit rate.
+- ``kernel-select``  the measured kernel-selector A/B: one generic-key
+                  Reduce forced onto the sort pipeline, forced onto
+                  the hash-aggregate cascade, then run under
+                  BIGSLICE_KERNEL_SELECT=measured; bit-parity and
+                  picked-the-winner are enforced, vs_baseline is the
+                  forced-worst arm.
 - ``cogroup``     the general ragged Cogroup: device tagged-sort +
                   rank-scatter lowering (discovered capacity) vs the
                   exact host sorted-merge tier as baseline.
@@ -235,6 +241,136 @@ def reduce_e2e_bench(keys, vals, iters: int = 3, dense_keys=None,
          f"device groups {sess.executor.device_group_count()}")
     _bytes_roofline("reduce_e2e", len(keys), 8, best, passes=passes)
     return len(keys) / best
+
+
+# --------------------------------------------------------- kernel-select
+
+def kernel_select_bench(n_rows: int, iters: int = 3):
+    """The PR-18 kernel-selector A/B: the SAME generic-key (non-dense)
+    keyed Reduce run three ways on one mesh — combine lowering forced
+    to the sort pipeline, forced to the hash-aggregate cascade, and
+    chosen by the measured selector (BIGSLICE_KERNEL_SELECT=measured:
+    one-shot timed probes of both cores at the observed shuffle scale,
+    probe programs landing in the cross-Session program cache).
+
+    Bit-parity across all three arms is ENFORCED (sorted result rows
+    compared), the measured arm's decision log is returned as evidence,
+    and the measured arm must both pick the kernel the forced A/B says
+    is faster AND beat the forced-WORST arm — the number that judges
+    what auto-selection buys over guessing wrong."""
+    import os
+
+    import bigslice_tpu as bs
+    from bigslice_tpu.exec.meshexec import MeshExecutor
+    from bigslice_tpu.exec.session import Session
+
+    mesh = _mesh()
+    n = mesh.devices.size
+    rng = np.random.RandomState(42)
+    # Sparse keys (multiplicative scramble over 2^30): the auto-dense
+    # staging probe declines, so the generic sort-vs-hash choice — the
+    # one the selector owns — is actually exercised. Cardinality stays
+    # moderate (2^12 distinct → ~128 rows/key) — the regime the
+    # probe's synthetic corpus (distinct = rows/4) models; a near-
+    # unique-key corpus has nothing to combine map-side and the hash
+    # cascade loses its reason to exist (docs/kernels.md).
+    keys = ((rng.randint(0, 1 << 12, n_rows).astype(np.int64)
+             * 92821 + 17) % (1 << 30)).astype(np.int32)
+    vals = np.ones(n_rows, dtype=np.int32)
+
+    def arm(env_mode, hash_aggregate, warm: int = 1):
+        """One configuration: fresh Session, warm pass(es), best-of-
+        iters wall, sorted result rows for the parity check. The env
+        knob is set around Session construction only — selector wiring
+        happens in Session.__init__."""
+        prev = os.environ.pop("BIGSLICE_KERNEL_SELECT", None)
+        if env_mode is not None:
+            os.environ["BIGSLICE_KERNEL_SELECT"] = env_mode
+        try:
+            sess = Session(executor=MeshExecutor(
+                mesh, auto_dense=False, hash_aggregate=hash_aggregate
+            ))
+        finally:
+            os.environ.pop("BIGSLICE_KERNEL_SELECT", None)
+            if prev is not None:
+                os.environ["BIGSLICE_KERNEL_SELECT"] = prev
+
+        def run_once(collect=False):
+            r = bs.Reduce(bs.Const(n, keys, vals), _add)
+            res = sess.run(r)
+            out = (sorted(map(tuple, res.rows())) if collect
+                   else sum(len(f) for f in res.frames()))
+            res.discard()
+            return out
+
+        # Warm compile caches; the measured arm gets an extra settle
+        # pass so a first-wave skew reselection (no hub stats exist
+        # before wave 0) lands before the timed region.
+        for _ in range(warm):
+            run_once()
+        times = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            run_once()
+            times.append(time.perf_counter() - t0)
+        rows = run_once(collect=True)
+        if sess.executor.device_group_count() == 0:
+            raise RuntimeError(
+                "kernel-select arm never engaged the device path")
+        sel = getattr(sess, "kernel_select", None)
+        evidence = sel.stats.summary() if sel is not None else None
+        sess.shutdown()
+        return len(keys) / min(times), rows, evidence
+
+    sort_rps, sort_rows, _ = arm(None, False)
+    hash_rps, hash_rows, _ = arm(None, True)
+    measured_rps, measured_rows, evidence = arm("measured", None,
+                                                warm=2)
+    if sort_rows != hash_rows or sort_rows != measured_rows:
+        raise RuntimeError(
+            "kernel-select arms disagree: forced-sort/forced-hash/"
+            "measured results must be bit-identical")
+
+    forced_best = "hash" if hash_rps >= sort_rps else "sort"
+    forced_worst_rps = min(sort_rps, hash_rps)
+    # The selector's live verdict for the DOMINANT boundary: latest
+    # sort-vs-hash decision per op (reselection re-decides), dominant
+    # = the op probing the largest observed corpus — the map-side
+    # combine that carries the e2e number. Dense-bound/ineligible
+    # entries are static facts about other boundaries, not choices.
+    finals = {}
+    probes = []
+    for d in (evidence or {}).get("decisions", ()):
+        if d.get("kernel") in ("hash", "sort"):
+            finals[d.get("op")] = d
+        if d.get("walls_ms"):
+            probes.append(d["walls_ms"])
+    picked = None
+    if finals:
+        dom = max(finals.values(),
+                  key=lambda d: d.get("max_rows")
+                  or d.get("probe_rows") or 0)
+        picked = dom["kernel"]
+    if picked != forced_best:
+        raise RuntimeError(
+            f"measured selector picked {picked!r} but the forced A/B "
+            f"says {forced_best} is faster "
+            f"(sort {sort_rps:,.0f} vs hash {hash_rps:,.0f} rows/s)")
+    note(f"kernel_select: forced-sort {sort_rps:,.0f} rows/s, "
+         f"forced-hash {hash_rps:,.0f} rows/s, measured "
+         f"{measured_rps:,.0f} rows/s (picked {picked}; "
+         f"{measured_rps / forced_worst_rps:.2f}x vs forced-worst)")
+    return {
+        "measured_rps": measured_rps,
+        "sort_rps": sort_rps,
+        "hash_rps": hash_rps,
+        "forced_best": forced_best,
+        "forced_worst_rps": forced_worst_rps,
+        "picked": picked,
+        "probe_walls_ms": probes,
+        "decisions": (evidence or {}).get("decisions", []),
+        "select_counts": (evidence or {}).get("counts", {}),
+    }
 
 
 # ----------------------------------------------------------- reduce-wave
@@ -1584,6 +1720,25 @@ def run_mode(mode: str, size, fallback: bool) -> None:
         dev = reduce_e2e_bench(keys, vals, dense_keys=n_keys)
         emit("reduce_by_key_dense_e2e_rows_per_sec", dev, "rows/sec",
              base)
+    elif mode == "kernel-select":
+        # The measured kernel-selector A/B (see kernel_select_bench):
+        # vs_baseline is the forced-WORST lowering on the same corpus
+        # — what auto-selection buys over shipping the wrong static
+        # choice. Bit-parity across all three arms and the picked-
+        # the-winner check are asserted inside the bench; the emitted
+        # line carries the decision log the CI smoke re-checks.
+        n_rows = size or (1 << 19 if fallback else 1 << 22)
+        r = kernel_select_bench(n_rows)
+        emit("kernel_select_e2e_rows_per_sec", r["measured_rps"],
+             "rows/sec", r["forced_worst_rps"],
+             parity="bit-identical",
+             picked=r["picked"],
+             forced_best=r["forced_best"],
+             forced_sort_rows_per_sec=round(r["sort_rps"], 3),
+             forced_hash_rows_per_sec=round(r["hash_rps"], 3),
+             probe_walls_ms=r["probe_walls_ms"],
+             select_counts=r["select_counts"],
+             decisions=r["decisions"])
     elif mode == "reduce-wave":
         # Wave streaming: S = 4×N shards force ceil(S/N)=4 waves
         # through the device per group, keys drawn from a genuinely
@@ -1873,7 +2028,7 @@ def main():
     known = ("reduce", "reduce-sort", "reduce-nohash", "reduce-dense",
              "reduce-wave", "reduce-wave-2d", "reduce-wave-staged",
              "reduce-wave-spill", "reduce-wave-adaptive",
-             "staging", "serve-qps",
+             "kernel-select", "staging", "serve-qps",
              "reduce-kernel", "join", "join-dense",
              "join-kernel", "wordcount", "sortshuffle", "cogroup",
              "kmeans", "attention", "matrix")

@@ -359,6 +359,23 @@ class AdaptivePlanner:
             self._cost_logged.add((action, op))
         self.stats.record("cost", action, op=op, **detail)
 
+    # -- cross-plane consumers ---------------------------------------------
+
+    def observe_kernel_wave(self, selector, op: str,
+                            hub_op: Optional[str] = None) -> None:
+        """Route the kernel selector's wave-boundary re-selection
+        consult (parallel/kernelselect.py, PR 18) through the planner:
+        the selector reads the SAME hub skew profile the skew policy
+        splits on, making it the first cross-plane consumer of the
+        telemetry this loop acts on. Advisory — a selector error must
+        never become a wave error."""
+        if selector is None:
+            return
+        try:
+            selector.observe_wave(op, hub_op=hub_op)
+        except Exception:
+            pass
+
     # -- spec policy -------------------------------------------------------
 
     def watch(self, tasks, executor) -> Optional["_SpecWatcher"]:

@@ -58,8 +58,18 @@ _compiler_serial = _itertools.count(1)
 
 class Compiler:
     def __init__(self, inv_index: int, machine_combiners: bool = False,
-                 mesh_signature=None, shuffle_mode=None):
+                 mesh_signature=None, shuffle_mode=None,
+                 kernel_select_mode=None):
         self.inv_index = inv_index
+        # Kernel auto-selection knob (parallel/kernelselect.py), frozen
+        # per compilation like shuffle_mode: the session resolves
+        # BIGSLICE_KERNEL_SELECT once per run and stamps the mode into
+        # every task's partition_config, so programs compiled under
+        # selector control can never share a device-plane digest (or
+        # the AOT program-cache key built on it) with legacy-default
+        # programs. None = knob unset — partition_config keeps its
+        # legacy 4-tuple shape, bit-identical digests included.
+        self.kernel_select_mode = kernel_select_mode
         # Static shuffle-plan knob (exec/shuffleplan.py), frozen per
         # compilation: the session resolves BIGSLICE_SHUFFLE once per
         # run and stamps it on every task, so one invocation's shuffle
@@ -224,6 +234,13 @@ class Compiler:
                 bool(part.partition_fn),
                 self.mesh_signature,
             )
+            if self.kernel_select_mode is not None:
+                # Appended only when the selector is engaged: the
+                # unset-knob descriptor stays the legacy 4-tuple, so
+                # chicken-bit runs keep byte-identical digests.
+                task.partition_config += (
+                    "kselect:" + self.kernel_select_mode,
+                )
             # Shuffle-plan stamps (exec/shuffleplan.py): the frozen
             # static knob, plus the compile-time spill-eligibility
             # verdict — machine-combined boundaries share one combiner

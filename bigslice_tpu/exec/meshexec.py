@@ -636,6 +636,12 @@ class MeshExecutor:
         # ``self.adaptive is not None`` before touching it, so with the
         # knob unset no adaptive code path executes at all.
         self.adaptive = None
+        # Kernel auto-selector (parallel/kernelselect.py), attached by
+        # the Session when BIGSLICE_KERNEL_SELECT engages a mode. Same
+        # chicken-bit shape as the planner: None means the hash/sort/
+        # dense routing below runs exactly the legacy platform
+        # defaults, bit-identical programs and cache keys included.
+        self.kernel_select = None
         # op base -> K of the last split run (observability/tests).
         self.split_runs: Dict[str, int] = {}
         # op base -> chosen attend lowering ("ring"/"ulysses"),
@@ -2070,6 +2076,36 @@ class MeshExecutor:
             )
         except Exception:
             pass
+        finally:
+            # The op's shuffle-size vector just updated — the honest
+            # moment for the kernel selector's re-selection consult.
+            self._kernel_reselect(task0)
+
+    def _kernel_reselect(self, task0: Task) -> None:
+        """Wave-boundary kernel re-selection (PR 18): the hub's
+        measured per-shard profile for this op just changed, so the
+        selector compares it against the snapshot its lowering
+        decision was based on and drops stale decisions (the next
+        program build re-decides — and re-probes — against current
+        reality). Routed through the adaptive planner when one is
+        attached: the selector is the first cross-plane consumer of
+        the telemetry the planner already acts on. Multiprocess meshes
+        skip it — the hub vector is rank-local there, and a
+        rank-diverging lowering decision would deadlock the
+        collective."""
+        sel = self.kernel_select
+        if sel is None or self.multiprocess:
+            return
+        opb = _op_base(task0.name.op)
+        sel.current_inv = task0.name.inv_index
+        try:
+            if self.adaptive is not None:
+                self.adaptive.observe_kernel_wave(
+                    sel, opb, hub_op=task0.name.op)
+            else:
+                sel.observe_wave(opb, hub_op=task0.name.op)
+        except Exception:
+            pass
 
     @staticmethod
     def _addressable_counts(counts):
@@ -3419,37 +3455,66 @@ class MeshExecutor:
         may serve this combiner (combine or combiner-bearing shuffle
         stage); None → the sort (or dense) path. ONE source of truth —
         the program builder and the overflow-retry router both call
-        this, so they cannot disagree about which lowering ran."""
-        if not self._hashagg_enabled() or opbase in self._hash_off:
+        this, so they cannot disagree about which lowering ran.
+
+        With a kernel selector attached (BIGSLICE_KERNEL_SELECT), the
+        final hash-vs-sort verdict for an ELIGIBLE combiner is the
+        selector's (static signals or measured probes); the hard gates
+        — overflow blacklist, dense precedence, the shared keyutil
+        rules, op classification — stay here and bound what it may
+        choose, so it can never route a combiner onto a lowering this
+        executor would refuse."""
+        sel = self.kernel_select
+        if sel is None and not self._hashagg_enabled():
             return None
-        if getattr(fc, "dense_keys", None) is not None:
+        if opbase in self._hash_off:
+            # Claim-cascade overflow blacklist: overrides any selector
+            # decision — the hash path has already proven too small
+            # for this op's key cardinality.
+            return None
+        dense_bound = getattr(fc, "dense_keys", None) is not None
+        if dense_bound and sel is None:
             # Declared/discovered dense bound: the rank-table lowering
             # (or, when it gates itself off, the sort path that honors
             # the badrange contract) takes precedence.
             return None
-        for ct in schema.key:
-            if ct.dtype == np.dtype(object) or ct.shape:
-                return None
-            if np.dtype(ct.dtype).kind == "f":
-                # Float keys diverge under the hash lowering: the claim
-                # cascade slot-hashes key BIT PATTERNS but compares
-                # with ==, so -0.0 and 0.0 claim separate slots (two
-                # output rows where the sort lowering merges them) and
-                # a NaN key can never match its own claimed slot
-                # (burns every cascade round, then blacklists the op).
-                # Float keys gain little from the hash path — route
-                # them to the sort lowering, which follows IEEE ==.
-                return None
-        from bigslice_tpu.parallel.dense import classified_ops_cached
+        from bigslice_tpu.parallel import keyutil
 
-        try:
-            return classified_ops_cached(
-                fc.fn, fc.nvals,
-                tuple(ct.dtype for ct in schema.values),
-                tuple(ct.shape for ct in schema.values),
+        ops = None
+        if keyutil.hash_keys_eligible(schema.key):
+            from bigslice_tpu.parallel.dense import (
+                classified_ops_cached,
             )
-        except TypeError:  # unhashable fn object: lru_cache key fails
-            return None
+
+            try:
+                ops = classified_ops_cached(
+                    fc.fn, fc.nvals,
+                    tuple(ct.dtype for ct in schema.values),
+                    tuple(ct.shape for ct in schema.values),
+                )
+            except TypeError:  # unhashable fn: lru_cache key fails
+                ops = None
+        if sel is None:
+            return ops
+        key_dtypes = tuple(str(np.dtype(ct.dtype))
+                           for ct in schema.key)
+        val_dtypes = tuple(str(np.dtype(ct.dtype))
+                           for ct in schema.values)
+        # Boundary-shape site key: identically-shaped boundaries of
+        # one op share a decision (and its probe); distinct shapes
+        # decide independently.
+        site = "k(%s)v(%s)" % (",".join(key_dtypes),
+                               ",".join(val_dtypes))
+        kernel = sel.choose(
+            opbase, site,
+            nkeys=len(schema.key), nvals=len(schema.values),
+            ops=ops or (), key_dtypes=key_dtypes,
+            val_dtypes=val_dtypes,
+            hash_eligible=ops is not None and not dense_bound,
+            dense_bound=dense_bound,
+            legacy_hash=self._hashagg_enabled(),
+        )
+        return ops if kernel == "hash" else None
 
     def _hash_join_ops(self, opbase: str, s):
         """(ops_a, ops_b) when the sortless hash join may serve this
@@ -3674,6 +3739,11 @@ class MeshExecutor:
                  slack: float = 2.0,
                  subids: Tuple[bool, ...] = (),
                  donate: Tuple[bool, ...] = ()):
+        if self.kernel_select is not None:
+            # Advisory trace-attribution hint only (never keyed on):
+            # selection instants fired while building this program
+            # land in the right invN bucket.
+            self.kernel_select.current_inv = task.name.inv_index
         stages = self._stages_for(task)
         if not subids:
             subids = tuple(False for _ in caps)
@@ -3688,6 +3758,14 @@ class MeshExecutor:
                task.num_partition, len(task.schema),
                self._input_ncols(task), slack, subids, donate,
                self._op_hash_engaged(task, stages))
+        if self.kernel_select is not None:
+            # The selector's live decision set keys the cache too:
+            # a wave-boundary re-selection must rebuild the program,
+            # not reuse one compiled under the old lowering. Appended
+            # only when a selector exists, so unset-env cache keys
+            # stay byte-identical to the legacy executor's.
+            key = key + (self.kernel_select.token(
+                _op_base(task.name.op)),)
         # The key embeds id()s of stage functions, which can recycle after
         # GC; weakrefs to the actual function objects guard each entry
         # (the jitutil._VMAP_CACHE pattern) — a recycled id recompiles
@@ -4268,7 +4346,9 @@ class MeshExecutor:
                    tuple((str(ct.dtype), tuple(ct.shape))
                          for ct in task.schema),
                    len(task.schema),
-                   self._op_hash_engaged(task, stages)),
+                   self._op_hash_engaged(task, stages))
+            + ((self.kernel_select.token(_op_base(task.name.op)),)
+               if self.kernel_select is not None else ()),
         )
         import weakref
 

@@ -394,6 +394,23 @@ class Session:
             if self.telemetry is not None:
                 self.telemetry.adaptive = planner.stats
             executor.adaptive = planner
+        # Kernel auto-selection (parallel/kernelselect.py):
+        # BIGSLICE_KERNEL_SELECT engages measured per-op lowering
+        # choice (sort vs hash vs dense) at every combine/shuffle
+        # boundary. Same chicken-bit contract as the planner: unset =
+        # selector_from_env returns None and NOTHING here attaches —
+        # legacy lowerings, bit-identical programs, zero
+        # bigslice_kernel_select_* samples.
+        self.kernel_select = None
+        from bigslice_tpu.parallel import kernelselect as kselect_mod
+
+        selector = kselect_mod.selector_from_env(self.telemetry)
+        if selector is not None:
+            self.kernel_select = selector
+            if self.telemetry is not None:
+                self.telemetry.kernel_select = selector.stats
+            if hasattr(executor, "kernel_select"):
+                executor.kernel_select = selector
         executor.start(self)
         # Rank-stamp the start event on multi-process gangs so
         # slicetrace's N-file merge (--merge) can assign each per-rank
@@ -483,6 +500,9 @@ class Session:
             inv_index, machine_combiners=self.machine_combiners,
             mesh_signature=self._mesh_signature(),
             shuffle_mode=shuffleplan_mod.plan_mode() or "",
+            kernel_select_mode=(self.kernel_select.mode
+                                if self.kernel_select is not None
+                                else None),
         ).compile(slice_)
         if self.debug is not None:
             self.debug.register_roots(tasks)

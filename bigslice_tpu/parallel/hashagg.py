@@ -38,11 +38,37 @@ skew (exec/meshexec.py slack ladder).
 
 from __future__ import annotations
 
+import os
 from typing import Callable, Optional, Sequence, Tuple
 
 import numpy as np
 
 from bigslice_tpu.parallel.jitutil import bucket_size
+
+#: Table-build backends: ``xla`` = the scatter lowering below,
+#: ``pallas`` = the Mosaic kernel (parallel/pallas_kernels.py,
+#: VMEM-resident table; compiles natively on TPU),
+#: ``pallas_interpret`` = the same kernel forced through the pallas
+#: interpreter (CPU parity tests / debugging).
+BACKENDS = ("xla", "pallas", "pallas_interpret")
+
+
+def _kernel_backend() -> str:
+    """Resolve the table-build backend: BIGSLICE_HASHAGG_BACKEND wins
+    (unknown values fail loudly); unset = ``pallas`` on real TPU (the
+    whole point — the scatter lowering is what loses there), ``xla``
+    everywhere else (bit-identical legacy behavior on CPU meshes)."""
+    env = os.environ.get("BIGSLICE_HASHAGG_BACKEND", "").strip().lower()
+    if env:
+        if env not in BACKENDS:
+            raise ValueError(
+                f"BIGSLICE_HASHAGG_BACKEND must be one of {BACKENDS}, "
+                f"got {env!r}"
+            )
+        return env
+    import jax
+
+    return "pallas" if jax.default_backend() == "tpu" else "xla"
 
 # Claim-cascade shape: FULL_ROUNDS full-width probe rounds, then the
 # pending stragglers compact into a size/CASCADE_DIV buffer where a
@@ -195,17 +221,38 @@ def claim_cascade(valid, key_cols, part, nparts: int, R: int,
 
 
 def hash_aggregate(valid, key_cols, val_cols, ops: Sequence[str],
-                   part, nparts: int, R: int, seed: int = 0):
+                   part, nparts: int, R: int, seed: int = 0,
+                   backend: Optional[str] = None):
     """Aggregate the selected rows by key into a [nparts*R] open table.
 
     Returns ``(present, out_keys, out_vals, overflow)`` — slot-resident
     results: ``present`` bool[T], key/value columns [T] (junk where not
     present; callers chain masks or compact). ``ops`` are the per-column
     classified combine ops ('add'|'max'|'min').
+
+    ``backend`` picks the table build: None resolves via
+    ``_kernel_backend()`` (env knob, then platform default). The Mosaic
+    kernel serves supported shapes/dtypes; anything it cannot take
+    falls back to the XLA scatter path below — slot layout may differ
+    between backends (sequential vs batched claim resolution) but the
+    per-region key sets and per-key combined values do not.
     """
     import jax.numpy as jnp
 
     from bigslice_tpu.parallel.dense import _identity, _scatter_tables
+
+    be = _kernel_backend() if backend is None else backend
+    if be != "xla":
+        from bigslice_tpu.parallel import pallas_kernels as pk
+
+        if pk.aggregate_supported(
+            [k.dtype for k in key_cols],
+            [v.dtype for v in val_cols], nparts, R,
+        ):
+            return pk.hash_aggregate_pallas(
+                valid, key_cols, val_cols, ops, part, nparts, R, seed,
+                interpret=(True if be == "pallas_interpret" else None),
+            )
 
     n = key_cols[0].shape[0]
     T = nparts * R
@@ -230,7 +277,7 @@ def combine_region_size(size: int, nparts: int) -> int:
 
 
 def make_hash_combine(nkeys: int, nvals: int, ops: Sequence[str],
-                      seed: int = 0):
+                      seed: int = 0, backend: Optional[str] = None):
     """Sortless replacement for make_segmented_reduce_masked (classified
     ops only): ``core(valid, key_cols, val_cols) -> (mask, keys, vals,
     overflow)`` with results slot-resident in a bucket_size(n) table.
@@ -245,7 +292,7 @@ def make_hash_combine(nkeys: int, nvals: int, ops: Sequence[str],
         part = jnp.zeros(n, np.int32)
         present, ok, ovs, ov = hash_aggregate(
             valid, tuple(key_cols), tuple(val_cols), ops, part, 1, R,
-            seed,
+            seed, backend=backend,
         )
         return present, tuple(ok), tuple(ovs), ov
 
@@ -256,7 +303,8 @@ def make_hash_combine_shuffle(nmesh: int, nkeys: int, nvals: int,
                               ops: Sequence[str], axis: str,
                               seed: int = 0,
                               partition_fn: Optional[Callable] = None,
-                              nparts: Optional[int] = None):
+                              nparts: Optional[int] = None,
+                              backend: Optional[str] = None):
     """Fused map-side combine + shuffle with zero sorts.
 
     The aggregation table is destination-contiguous (region p = the keys
@@ -293,7 +341,8 @@ def make_hash_combine_shuffle(nmesh: int, nkeys: int, nvals: int,
         )
         R = combine_region_size(size, nparts)
         present, ok, ovs, ov = hash_aggregate(
-            valid, keys, vals, ops, part, nparts, R, seed
+            valid, keys, vals, ops, part, nparts, R, seed,
+            backend=backend,
         )
 
         def route(x):

@@ -1,0 +1,55 @@
+"""Shared key-eligibility rules for the hash lowerings.
+
+The open-addressed hash paths (parallel/hashagg.py, the Mosaic kernel in
+parallel/pallas_kernels.py) slot-hash key BIT PATTERNS but compare with
+``==``. Three key families break that contract and must route to the
+sort lowering, which honors value semantics exactly:
+
+- **object dtype** — host-side Python payloads; no device hash exists.
+- **shaped columns** — per-row vectors; the claim cascade compares
+  scalars.
+- **float kinds** — ``-0.0`` and ``0.0`` hash to different slots (two
+  output rows where the sort lowering merges them), and a NaN key can
+  never match its own claimed slot (burns every cascade round, then
+  blacklists the op).
+
+This module is the ONE place those rules live. The mesh executor's
+``_hash_combine_ops`` gate and the kernel selector
+(parallel/kernelselect.py) both call it, so the selector can never route
+a float-keyed op onto a hash path the executor would refuse — and a new
+rule added here reaches every caller at once.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+def hash_key_ineligible_reason(key_types: Sequence) -> Optional[str]:
+    """Why these key columns must NOT take a hash lowering, or None
+    when they are eligible. ``key_types`` is a sequence of column types
+    with ``dtype`` and ``shape`` attributes (frame schema entries), or
+    bare dtypes (``shape`` defaults to scalar)."""
+    for ct in key_types:
+        dtype = getattr(ct, "dtype", ct)
+        shape = getattr(ct, "shape", ())
+        if np.dtype(dtype) == np.dtype(object):
+            return "object-dtype key"
+        if tuple(shape):
+            return "shaped key column"
+        if np.dtype(dtype).kind == "f":
+            # Float keys diverge under the hash lowering: the claim
+            # cascade slot-hashes key BIT PATTERNS but compares with
+            # ==, so -0.0 and 0.0 claim separate slots and a NaN key
+            # can never match its own claimed slot. Float keys gain
+            # little from the hash path — route them to the sort
+            # lowering, which follows IEEE ==.
+            return "float-kind key"
+    return None
+
+
+def hash_keys_eligible(key_types: Sequence) -> bool:
+    """True when every key column may take a hash lowering."""
+    return hash_key_ineligible_reason(key_types) is None

@@ -145,6 +145,7 @@ def _print_inv(out: List[str], inv, summary: dict, tasks: List[dict],
     _print_exchange(out, inv, telem.get("exchange", ()))
     _print_spill(out, inv, telem.get("spill", ()))
     _print_adaptive(out, inv, telem.get("adaptive", ()))
+    _print_kernels(out, inv, telem.get("kernels", ()))
     out.append("")
 
 
@@ -432,6 +433,28 @@ def _print_adaptive(out: List[str], inv, events):
                    f"{evidence}")
 
 
+def _print_kernels(out: List[str], inv, events):
+    """Kernel-selector lowering decisions from bigslice:kernel_select
+    instants (parallel/kernelselect.py): which kernel each combine/
+    shuffle boundary got, why (static signal vs measured probe), and
+    the probe evidence — absent entirely when BIGSLICE_KERNEL_SELECT
+    is unset (the selector never emits)."""
+    if not events:
+        return
+    out.append(f"# inv{inv}:kernels (kernel-selector decisions)")
+    out.append(f"  {'kernel':<8} {'reason':<24} {'op':<24} evidence")
+    for ev in events[-24:]:
+        a = dict(ev.get("args", {}))
+        kernel = str(a.pop("kernel", "?"))
+        reason = str(a.pop("reason", "?"))
+        op = str(a.pop("op", None) or "-")
+        a.pop("inv", None)
+        a.pop("site", None)
+        evidence = " ".join(f"{k}={a[k]}" for k in sorted(a)) or "-"
+        out.append(f"  {kernel:<8} {reason[:24]:<24} {op[:24]:<24} "
+                   f"{evidence}")
+
+
 def analyze(path: str) -> str:
     with open(path) as fp:
         doc = json.load(fp)
@@ -449,6 +472,7 @@ def analyze(path: str) -> str:
         "bigslice:exchange": "exchange",
         "bigslice:spill": "spill",
         "bigslice:adaptive": "adaptive",
+        "bigslice:kernel_select": "kernels",
     }
     n_tasks = n_instants = 0
     for ev in doc.get("traceEvents", []):

@@ -24,6 +24,14 @@ def force_hermetic_cpu() -> None:
     import os
 
     os.environ["JAX_PLATFORMS"] = "cpu"
+    # libtpu's topology path (jax.experimental.topologies, used by the
+    # AOT compile checks) queries the GCP instance-metadata server for
+    # host-bounds variables — 30 HTTP retries per variable, ~8 minutes
+    # of pure network wait on any non-GCP host before it gives up and
+    # proceeds anyway. Hermetic means no metadata courtship; AOT
+    # topology descriptions never need it. setdefault so an explicit
+    # operator choice still wins.
+    os.environ.setdefault("TPU_SKIP_MDS_QUERY", "1")
     try:
         import jax
         from jax._src import xla_bridge as _xb

@@ -218,6 +218,13 @@ class TelemetryHub:
         # and the bigslice_adaptive_* Prometheus families. None with
         # the knob unset — neither family ever emits a sample then.
         self.adaptive = None
+        # Kernel-selection plane (parallel/kernelselect.py): the
+        # Session attaches its selector's KernelSelectStats here when
+        # BIGSLICE_KERNEL_SELECT engages a mode, so lowering decisions
+        # ride summary()["kernel_select"] and the
+        # bigslice_kernel_select_* Prometheus families. None with the
+        # knob unset — neither family ever emits a sample then.
+        self.kernel_select = None
         self.skew_ratio = skew_ratio
         self.skew_min_rows = skew_min_rows
         self.straggler_factor = straggler_factor
@@ -798,6 +805,12 @@ class TelemetryHub:
                 out["adaptive"] = adaptive.summary()
             except Exception:
                 out["adaptive"] = {}
+        kselect = self.kernel_select
+        if kselect is not None:
+            try:
+                out["kernel_select"] = kselect.summary()
+            except Exception:
+                out["kernel_select"] = {}
         return out
 
     def snapshot(self, rank: Optional[int] = None,
@@ -1190,6 +1203,14 @@ class TelemetryHub:
         if adaptive is not None:
             try:
                 adaptive.prometheus_lines(metric, line)
+            except Exception:
+                pass
+
+        # -- kernel-selection plane (parallel/kernelselect.py) --------
+        kselect = self.kernel_select
+        if kselect is not None:
+            try:
+                kselect.prometheus_lines(metric, line)
             except Exception:
                 pass
 

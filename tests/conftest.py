@@ -47,3 +47,11 @@ def sess(request):
 
     mesh = Mesh(np.array(jax.devices()[:8]), ("shards",))
     return Session(executor=MeshExecutor(mesh))
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: full-matrix recompile variants outside the tier-1 "
+        "'not slow' budget",
+    )

@@ -1,0 +1,346 @@
+"""Kernel auto-selection (parallel/kernelselect.py): measured per-op
+lowering choice behind BIGSLICE_KERNEL_SELECT.
+
+The acceptance criteria this file pins:
+
+- unset env = fully disengaged: no selector attaches, partition_config
+  keeps its legacy 4-tuple shape, and no ``bigslice_kernel_select_*``
+  family ever emits a sample (the chicken-bit contract);
+- the selection matrix routes each corpus to the right lowering —
+  hash for sparse classified int keys (static: the CPU scatter path
+  wins), sort for float keys (the shared keyutil gate), dense for
+  declared/discovered dense bounds — with results value-identical to
+  the unset-env run, on 1-D and 2×4 hierarchical meshes, staging
+  arena on and off;
+- measured probes compile through the device plane's instrument seam
+  and land in the cross-session program cache: a second Session's
+  probe is a cross-session hit with zero compiles;
+- a skew-profile shift between waves drops the decision (and probe)
+  so the next build re-selects against the measured corpus;
+- every decision lands in telemetry_summary()["kernel_select"],
+  Prometheus, and the invN:kernels slicetrace section.
+"""
+
+import numpy as np
+import pytest
+
+import bigslice_tpu as bs
+from bigslice_tpu.exec.session import Session
+from bigslice_tpu.parallel import kernelselect as ks
+from bigslice_tpu.utils.telemetry import TelemetryHub
+
+
+def _mesh(hier=False):
+    import jax
+    from jax.sharding import Mesh
+
+    if hier:
+        return Mesh(np.array(jax.devices()[:8]).reshape(2, 4),
+                    ("dcn", "ici"))
+    return Mesh(np.array(jax.devices()[:8]), ("shards",))
+
+
+def _sparse_keys(rows=4000, distinct=300, seed=7):
+    """Classified int32 keys over a range auto-dense cannot take."""
+    rng = np.random.RandomState(seed)
+    k = rng.randint(0, distinct, rows).astype(np.int64)
+    return ((k * 92821 + 17) % (1 << 30)).astype(np.int32)
+
+
+def _reduce_oracle(keys):
+    out = {}
+    for k in keys.tolist():
+        out[k] = out.get(k, 0) + 1
+    return out
+
+
+def _count_pipeline(keys):
+    return bs.Reduce(
+        bs.Const(8, keys, np.ones(len(keys), np.int32)),
+        lambda a, b: a + b,
+    )
+
+
+def _mesh_run(pipeline, hier=False, arena=True):
+    from bigslice_tpu.exec.meshexec import MeshExecutor
+
+    sess = Session(executor=MeshExecutor(_mesh(hier=hier),
+                                         staging_arena=arena))
+    res = sess.run(pipeline)
+    rows = sorted(map(tuple, res.rows()))
+    return rows, sess
+
+
+# -------------------------------------------------------- env parsing
+
+
+def test_mode_from_env_parsing():
+    assert ks.mode_from_env("") is None
+    assert ks.mode_from_env("off") is None
+    assert ks.mode_from_env("static") == "static"
+    assert ks.mode_from_env("MEASURED") == "measured"
+    with pytest.raises(ValueError):
+        ks.mode_from_env("frobnicate")
+
+
+def test_selector_from_env_chicken_bit(monkeypatch):
+    monkeypatch.delenv("BIGSLICE_KERNEL_SELECT", raising=False)
+    assert ks.selector_from_env() is None
+    monkeypatch.setenv("BIGSLICE_KERNEL_SELECT", "off")
+    assert ks.selector_from_env() is None
+    monkeypatch.setenv("BIGSLICE_KERNEL_SELECT", "static")
+    sel = ks.selector_from_env()
+    assert sel is not None and sel.mode == "static"
+
+
+# -------------------------------------------------------- chicken bit
+
+
+def test_session_chicken_bit_zero_samples(monkeypatch):
+    """Unset knob: no selector attaches anywhere, partition_config
+    keeps the legacy 4-tuple, and neither the summary key nor any
+    bigslice_kernel_select_* Prometheus sample exists."""
+    monkeypatch.delenv("BIGSLICE_KERNEL_SELECT", raising=False)
+    keys = _sparse_keys()
+    rows, sess = _mesh_run(_count_pipeline(keys))
+    assert dict(rows) == _reduce_oracle(keys)
+    assert sess.kernel_select is None
+    assert sess.executor.kernel_select is None
+    assert sess.telemetry.kernel_select is None
+    assert "kernel_select" not in sess.telemetry_summary()
+    assert "bigslice_kernel_select" not in \
+        sess.telemetry.prometheus_text()
+
+
+def test_partition_config_stamp(monkeypatch):
+    """The compiler stamps the frozen mode into partition_config ONLY
+    when the selector is engaged — unset runs keep the legacy shape,
+    so device-plane digests stay byte-identical."""
+    from bigslice_tpu.exec import compile as compile_mod
+
+    s = bs.Reduce(bs.Const(4, np.arange(32, dtype=np.int32),
+                           np.ones(32, np.int32)), lambda a, b: a + b)
+    legacy = compile_mod.Compiler(1).compile(s)
+    assert all(len(t.partition_config) == 4 for t in legacy)
+    stamped = compile_mod.Compiler(
+        2, kernel_select_mode="measured").compile(s)
+    assert all(t.partition_config[-1] == "kselect:measured"
+               for t in stamped)
+
+
+# -------------------------------------- selection matrix, with parity
+
+
+@pytest.mark.parametrize(
+    "arena",
+    [
+        # The arena variants recompile the full three-corpus matrix
+        # (~30s on the 1-vCPU runner) — full-suite coverage, outside
+        # the tier-1 'not slow' budget.
+        pytest.param(True, marks=pytest.mark.slow, id="arena"),
+        pytest.param(False, id="noarena"),
+    ])
+@pytest.mark.parametrize(
+    "hier",
+    [
+        pytest.param(False, id="1d"),
+        # Hier recompiles everything for the 2-D exchange; 1-D covers
+        # the tier-1 budget, the 2×4 grid runs in the full suite.
+        pytest.param(True, marks=pytest.mark.slow, id="2x4"),
+    ])
+def test_selection_matrix_parity(hier, arena, monkeypatch):
+    """sort vs hash vs dense, decided per boundary, value-identical
+    on every mesh/arena config:
+
+    - sparse classified int32 keys → hash (static: CPU scatter wins),
+      bit-compared against the unset-env session — the one boundary
+      the selector actually flips;
+    - float32 keys → sort (the shared keyutil gate — the selector may
+      never route float keys onto a hash path);
+    - small contiguous int keys → dense (auto-discovered bound takes
+      precedence, as it always has).
+
+    The float/dense corpora compare against the host oracle instead
+    of a second baseline session (their lowerings are the legacy
+    defaults either way; one mesh compile each instead of two keeps
+    the matrix inside the tier-1 budget)."""
+    rng = np.random.RandomState(11)
+    sparse = _sparse_keys()
+    floats = rng.randn(4000).astype(np.float32)
+    floats[::101] = 0.0
+    floats[1::101] = -0.0
+    dense = rng.randint(0, 64, 4000).astype(np.int32)
+    corpora = {"hash": sparse, "sort": floats, "dense": dense}
+
+    monkeypatch.delenv("BIGSLICE_KERNEL_SELECT", raising=False)
+    base, base_sess = _mesh_run(_count_pipeline(sparse),
+                                hier=hier, arena=arena)
+    assert base_sess.kernel_select is None
+    for want, keys in corpora.items():
+        monkeypatch.setenv("BIGSLICE_KERNEL_SELECT", "static")
+        got, sess = _mesh_run(_count_pipeline(keys),
+                              hier=hier, arena=arena)
+        if want == "hash":
+            assert got == base, want
+        else:
+            oracle = _reduce_oracle(keys)
+            assert len(got) == len(oracle) and all(
+                oracle[k] == v for k, v in got), want
+        st = sess.kernel_select.stats
+        assert st.count(want) >= 1, (want, st.summary()["counts"])
+        reasons = {d["reason"] for d in st.summary()["decisions"]
+                   if d["kernel"] == want}
+        if want == "hash":
+            assert "static:cpu-scatter-wins" in reasons
+        elif want == "sort":
+            assert "hash-ineligible" in reasons
+        else:
+            assert "dense-bound" in reasons
+        # Attribution surfaces on the summary plane too.
+        assert sess.telemetry_summary()["kernel_select"]["counts"][
+            want]
+
+
+def test_measured_mode_end_to_end(monkeypatch):
+    """Measured mode on a real mesh run: probes race sort vs hash on
+    the op's corpus shape, the winner is attributed with wall-clock
+    evidence, and the result is value-identical to the unset run."""
+    keys = _sparse_keys(rows=6000)
+    monkeypatch.delenv("BIGSLICE_KERNEL_SELECT", raising=False)
+    base, _ = _mesh_run(_count_pipeline(keys))
+    monkeypatch.setenv("BIGSLICE_KERNEL_SELECT", "measured")
+    got, sess = _mesh_run(_count_pipeline(keys))
+    assert got == base
+    decisions = sess.kernel_select.stats.summary()["decisions"]
+    probed = [d for d in decisions
+              if d["reason"] in ("measured:probe", "measured:margin")]
+    assert probed, decisions
+    assert all("walls_ms" in d for d in probed
+               if d["reason"] == "measured:probe")
+
+
+# ------------------------------------------- probes + program cache
+
+
+def test_probe_compiles_land_in_program_cache(monkeypatch):
+    """The measured probe's compiled sort/hash alternatives land in
+    the PR-14 cross-session program cache: a second Session probing
+    the same op-shape serves both from cache — compiles == 0."""
+    monkeypatch.delenv("BIGSLICE_KERNEL_SELECT", raising=False)
+    totals = []
+    for _ in range(2):
+        hub = TelemetryHub()
+        sel = ks.KernelSelector("measured", hub)
+        kernel = sel.choose(
+            "ksel-cache-op", "s", nkeys=1, nvals=1, ops=("add",),
+            key_dtypes=("int32",), val_dtypes=("int32",),
+            hash_eligible=True, dense_bound=False, legacy_hash=True)
+        assert kernel in ("hash", "sort")
+        totals.append(hub.device.summary()["totals"])
+    first, second = totals
+    assert first["compiles"] == 2  # sort core + hash core
+    assert second["compiles"] == 0
+    assert second["cross_session_hits"] == 2
+
+
+def test_multiprocess_takes_static_path(monkeypatch):
+    """Timed probes are single-process only: wall clocks diverge
+    across SPMD ranks and a rank-diverging lowering choice would
+    deadlock the collective — gangs get the deterministic static
+    verdict, attributed as such."""
+    import jax
+
+    sel = ks.KernelSelector("measured", None)
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    kernel = sel.choose(
+        "ksel-mp-op", "s", nkeys=1, nvals=1, ops=("add",),
+        key_dtypes=("int32",), val_dtypes=("int32",),
+        hash_eligible=True, dense_bound=False, legacy_hash=True)
+    assert kernel == "hash"  # CPU static default
+    d = sel.stats.summary()["decisions"][0]
+    assert d["reason"] == "static:multiprocess"
+    assert "walls_ms" not in d
+
+
+# ---------------------------------------------------- re-selection
+
+
+def test_reselect_on_skew_shift(monkeypatch):
+    """A RESELECT_RATIO shift in the op's measured per-shard profile
+    drops the decision and its probe; the next consult re-decides
+    (and the fresh decision snapshots the new profile)."""
+    hub = TelemetryHub()
+    sel = ks.KernelSelector("measured", hub)
+    monkeypatch.setattr(
+        ks.KernelSelector, "_run_probe",
+        lambda self, *a, **k: {"winner": "hash",
+                               "walls_ms": {"hash": 1.0,
+                                            "sort": 2.0}})
+    kw = dict(nkeys=1, nvals=1, ops=("add",),
+              key_dtypes=("int32",), val_dtypes=("int32",),
+              hash_eligible=True, dense_bound=False,
+              legacy_hash=True)
+    # Decide against a measured profile...
+    hub.record_shuffle("op1", 1, [100, 100, 100, 100])
+    assert sel.choose("op1", "s", **kw) == "hash"
+    assert sel.decision("op1", "s") == "hash"
+    assert sel.token("op1") == (("s", "hash"),)
+    # ...a same-scale wave shifts nothing...
+    hub.record_shuffle("op1", 1, [10, 10, 10, 10])
+    sel.observe_wave("op1")
+    assert sel.decision("op1", "s") == "hash"
+    # ...but a 2x max-shard shift drops the decision.
+    hub.record_shuffle("op1", 1, [900, 0, 0, 0])
+    sel.observe_wave("op1")
+    assert sel.decision("op1", "s") is None
+    assert sel.token("op1") == ()
+    assert sel.stats.count("reselect", "measured:skew-shift") == 1
+    # The next consult re-decides and the token re-forms.
+    assert sel.choose("op1", "s", **kw) == "hash"
+    assert sel.token("op1") == (("s", "hash"),)
+
+
+def test_static_mode_never_reselects():
+    sel = ks.KernelSelector("static", TelemetryHub())
+    sel.hub.record_shuffle("op1", 1, [1000, 0, 0, 0])
+    sel.observe_wave("op1")  # no-op: nothing recorded, nothing raised
+    assert sel.stats.samples == 0
+
+
+# ------------------------------------- rendering: Prometheus + trace
+
+
+def test_prometheus_families(monkeypatch):
+    hub = TelemetryHub()
+    sel = ks.KernelSelector("static", hub)
+    hub.kernel_select = sel.stats
+    sel.choose("promop", "s", nkeys=1, nvals=1, ops=("add",),
+               key_dtypes=("int32",), val_dtypes=("int32",),
+               hash_eligible=True, dense_bound=False,
+               legacy_hash=True)
+    text = hub.prometheus_text()
+    assert ('bigslice_kernel_select_mode{mode="static"} 1'
+            in text)
+    assert ('bigslice_kernel_select_total{kernel="hash",'
+            'reason="static:cpu-scatter-wins"} 1') in text
+
+
+def test_slicetrace_renders_kernels_section(tmp_path, monkeypatch):
+    """A real selection's bigslice:kernel_select instant carries the
+    invocation tag and renders as an invN:kernels section offline."""
+    from bigslice_tpu.exec.meshexec import MeshExecutor
+    from bigslice_tpu.tools import slicetrace
+
+    monkeypatch.setenv("BIGSLICE_KERNEL_SELECT", "static")
+    trace = tmp_path / "trace.json"
+    keys = _sparse_keys()
+    sess = Session(executor=MeshExecutor(_mesh()),
+                   trace_path=str(trace))
+    res = sess.run(_count_pipeline(keys))
+    assert dict(map(tuple, res.rows())) == _reduce_oracle(keys)
+    assert sess.kernel_select.stats.samples >= 1
+    sess.shutdown()  # writes the trace
+    report = slicetrace.analyze(str(trace))
+    assert ":kernels" in report
+    assert "static:cpu-scatter-wins" in report or \
+        "dense-bound" in report

@@ -11,6 +11,16 @@ import sys
 import pytest
 
 
+def _skip_if_no_cpu_collectives(out):
+    """This jaxlib build may lack multi-process CPU collectives (gloo);
+    the capability only surfaces inside the spawned workers — convert
+    that environment limitation into a skip, same as the telemetry
+    smoke below."""
+    if "Multiprocess computations aren't implemented" in (
+            out.stdout + out.stderr):
+        pytest.skip("jaxlib cannot run multiprocess CPU collectives")
+
+
 def test_two_process_distributed_smoke():
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
@@ -19,6 +29,7 @@ def test_two_process_distributed_smoke():
         capture_output=True, text=True, timeout=180, env=env,
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     )
+    _skip_if_no_cpu_collectives(out)
     assert out.returncode == 0, out.stderr[-2000:]
     assert "MULTIHOST_SMOKE_OK processes=2" in out.stdout
     # The distributed Session ran end-to-end (compile → ordered SPMD
@@ -46,6 +57,7 @@ def test_wedged_peer_detected_by_keepalive():
         capture_output=True, text=True, timeout=240, env=env,
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     )
+    _skip_if_no_cpu_collectives(out)
     assert out.returncode == 0, (out.stdout[-1500:], out.stderr[-1500:])
     assert "WEDGE_OK" in out.stdout
 
@@ -62,6 +74,7 @@ def test_host_loss_surfaces_fast():
         capture_output=True, text=True, timeout=240, env=env,
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     )
+    _skip_if_no_cpu_collectives(out)
     assert out.returncode == 0, (out.stdout[-1500:], out.stderr[-1500:])
     assert "CHAOS_OK" in out.stdout
 
@@ -106,5 +119,6 @@ def test_mid_collective_kill_classified_fast():
         capture_output=True, text=True, timeout=400, env=env,
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     )
+    _skip_if_no_cpu_collectives(out)
     assert out.returncode == 0, (out.stdout[-1500:], out.stderr[-1500:])
     assert "KILLRUN_OK" in out.stdout
