@@ -835,6 +835,169 @@ def reduce_wave_adaptive_bench(n_rows: int, slow_s: float = 0.5,
     }
 
 
+# --------------------------------------------------- reduce-wave-coded
+
+def reduce_wave_coded_bench(n_rows: int, slow_s: float = 1.2):
+    """The coded k-of-n straggler-tolerance A/B (exec/codedplan.py),
+    three arms under an IDENTICAL fixed-seed fault plan that makes the
+    first map-side task sleep ``slow_s``–``2*slow_s`` seconds
+    (utils/faultinject.py ``task.run`` ``~slow`` — a deterministic
+    slow host):
+
+    - **off**: the baseline pays the straggler in full — its wall is
+      bounded BELOW by the injected sleep.
+    - **spec** (reactive): the straggler watcher detects the slow task
+      after the fact and races a duplicate; the duplicate wins, but
+      only after the detection latency already elapsed.
+    - **coded** (proactive, spec policy STILL ARMED): the planner
+      over-decomposed the combine boundary into n = k + r members
+      before anything ran; coverage settles on the k fastest, the
+      sleeper is cooperatively cancelled, and ZERO speculative
+      duplicates dispatch — redundancy was pre-paid, not raced.
+
+    Asserted, not printed: all three arms value-identical; spec
+    launched >= 1 and won >= 1; coded covered with launched == 0; and
+    the coded wall at least 2x better than off (the k-th-slowest
+    bound vs the straggler-bound baseline)."""
+    import os
+
+    import bigslice_tpu as bs
+    from bigslice_tpu.exec.local import LocalExecutor
+    from bigslice_tpu.exec.session import Session
+    from bigslice_tpu.utils import faultinject
+
+    env_keys = ("BIGSLICE_ADAPTIVE", "BIGSLICE_ADAPTIVE_POLL_S",
+                "BIGSLICE_CHAOS_SLOW_S", "BIGSLICE_CODED",
+                "BIGSLICE_CODED_REDUNDANCY")
+    prev = {k: os.environ.get(k) for k in env_keys}
+
+    def restore_env():
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    rng = np.random.RandomState(3)
+    keys = rng.randint(0, 199, n_rows).astype(np.int32)
+    vals = np.ones(n_rows, np.int32)
+    plan_spec = "11:task.run=1.0x1~slow"
+
+    def leg(adaptive, coded):
+        os.environ["BIGSLICE_ADAPTIVE"] = adaptive
+        os.environ["BIGSLICE_ADAPTIVE_POLL_S"] = "0.005"
+        os.environ["BIGSLICE_CHAOS_SLOW_S"] = str(slow_s)
+        if coded:
+            os.environ["BIGSLICE_CODED"] = "combine"
+        else:
+            os.environ.pop("BIGSLICE_CODED", None)
+        sess = None
+        try:
+            sess = Session(executor=LocalExecutor(procs=4))
+            # Detection floor at a quarter of the injected sleep:
+            # the 1.2s+ sleeper is flagged, honest sub-0.3s shards
+            # never are — both reactive arms see the same signal.
+            sess.telemetry.straggler_factor = 1.5
+            sess.telemetry.straggler_min_secs = slow_s / 4.0
+            sess.telemetry.straggler_min_siblings = 2
+            res = sess.run(bs.Reduce(bs.Const(8, keys, vals), _add))
+            rows = sorted(res.rows())  # chaos-free warm
+            res.discard()
+            faultinject.install(faultinject.parse_plan(plan_spec))
+            try:
+                t0 = time.perf_counter()
+                res = sess.run(bs.Reduce(bs.Const(8, keys, vals),
+                                         _add))
+                rows = sorted(res.rows())
+                wall = time.perf_counter() - t0
+            finally:
+                faultinject.clear()
+            # Settle before teardown: cancelled/raced stragglers may
+            # still be draining their current frame on worker threads;
+            # the wall above is already measured, but exiting the
+            # process mid-native-op aborts the runtime.
+            from bigslice_tpu.exec.task import TaskState, iter_tasks
+
+            settle = time.monotonic() + 2 * slow_s + 5.0
+            while time.monotonic() < settle and any(
+                    t.state == TaskState.RUNNING
+                    for t in iter_tasks(res.tasks)):
+                time.sleep(0.02)
+            res.discard()
+            spec = {"launched": 0, "won": 0, "wasted": 0}
+            if sess.adaptive is not None:
+                st = sess.adaptive.stats
+                deadline = time.monotonic() + 10.0
+                while time.monotonic() < deadline:
+                    if (st.speculative_won + st.speculative_wasted
+                            >= st.speculative_launched):
+                        break
+                    time.sleep(0.02)
+                spec = {"launched": st.speculative_launched,
+                        "won": st.speculative_won,
+                        "wasted": st.speculative_wasted}
+            cd = sess.telemetry.coded
+            coded_counts = (dict(cd.summary()["counts"])
+                            if cd is not None else {})
+            return rows, wall, spec, coded_counts
+        finally:
+            if sess is not None:
+                sess.shutdown()
+            restore_env()
+
+    off_rows, off_wall, _, off_coded = leg("off", coded=False)
+    spec_rows_, spec_wall, spec, _ = leg("spec", coded=False)
+    coded_rows, coded_wall, coded_spec, coded_counts = leg(
+        "spec", coded=True)
+
+    if spec_rows_ != off_rows or coded_rows != off_rows:
+        raise RuntimeError("coded A/B arms are not value-identical")
+    if off_coded:
+        raise RuntimeError(
+            f"chicken bit leaked: off arm has coded events {off_coded}"
+        )
+    if off_wall < slow_s:
+        raise RuntimeError(
+            f"off arm finished below the injected sleep "
+            f"({off_wall:.3f}s < {slow_s}s) — the fault never landed"
+        )
+    if spec["launched"] < 1 or spec["won"] < 1:
+        raise RuntimeError(
+            f"speculation never engaged/won in the spec arm: {spec}"
+        )
+    if coded_spec["launched"] != 0:
+        raise RuntimeError(
+            f"coded arm dispatched speculative duplicates: "
+            f"{coded_spec} — redundancy is pre-paid, racing it "
+            f"double-spends"
+        )
+    if coded_counts.get("covered", 0) < 1:
+        raise RuntimeError(
+            f"coded arm never settled coverage: {coded_counts}"
+        )
+    if not coded_wall * 2 <= off_wall:
+        raise RuntimeError(
+            f"coded wall not >=2x better than off: {coded_wall:.3f}s "
+            f"vs {off_wall:.3f}s"
+        )
+    note(f"reduce_wave_coded: off {off_wall:.2f}s, spec "
+         f"{spec_wall:.2f}s ({spec['launched']} raced, {spec['won']} "
+         f"won), coded {coded_wall:.2f}s "
+         f"(covered, {coded_counts.get('cancelled', 0)} cancelled, "
+         f"0 raced), value-identical x3")
+
+    return {
+        "off_wall_s": off_wall,
+        "spec_wall_s": spec_wall,
+        "coded_wall_s": coded_wall,
+        "off_rps": n_rows / off_wall,
+        "spec_rps": n_rows / spec_wall,
+        "coded_rps": n_rows / coded_wall,
+        "speculative": spec,
+        "coded_counts": coded_counts,
+    }
+
+
 # ------------------------------------------------------------- staging
 
 def staging_bench(n_rows: int, dim: int = 16, iters: int = 7):
@@ -1859,6 +2022,26 @@ def run_mode(mode: str, size, fallback: bool) -> None:
              skew_splits=r["skew_splits"],
              skew_off_rows_per_sec=round(r["skew_off_rps"], 3),
              skew_all_rows_per_sec=round(r["skew_all_rps"], 3))
+    elif mode == "reduce-wave-coded":
+        # Proactive straggler tolerance A/B (see reduce_wave_coded_
+        # bench): off vs reactive speculation vs coded k-of-n coverage
+        # under the identical fixed-seed slow-host plan. Value parity
+        # x3, zero speculative dispatch in the coded arm, and the 2x
+        # wall win over off are asserted inside the bench; the emitted
+        # line carries the evidence the CI smoke re-checks.
+        n_rows = size or (1 << 16 if fallback else 1 << 18)
+        r = reduce_wave_coded_bench(n_rows)
+        emit("reduce_wave_coded_e2e_rows_per_sec", r["coded_rps"],
+             "rows/sec", r["off_rps"],
+             parity="value-identical-x3",
+             off_wall_s=round(r["off_wall_s"], 3),
+             spec_wall_s=round(r["spec_wall_s"], 3),
+             coded_wall_s=round(r["coded_wall_s"], 3),
+             wall_improvement=round(
+                 r["off_wall_s"] / r["coded_wall_s"], 2),
+             speculative_in_coded_arm=0,
+             spec_arm=r["speculative"],
+             coded=r["coded_counts"])
     elif mode == "reduce-wave-staged":
         # The serving shape: waved Reduce whose shards stage from
         # encoded stream files (read → decode → assemble → upload is
@@ -2028,6 +2211,7 @@ def main():
     known = ("reduce", "reduce-sort", "reduce-nohash", "reduce-dense",
              "reduce-wave", "reduce-wave-2d", "reduce-wave-staged",
              "reduce-wave-spill", "reduce-wave-adaptive",
+             "reduce-wave-coded",
              "kernel-select", "staging", "serve-qps",
              "reduce-kernel", "join", "join-dense",
              "join-kernel", "wordcount", "sortshuffle", "cogroup",

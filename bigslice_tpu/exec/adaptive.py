@@ -425,6 +425,14 @@ class _SpecWatcher:
             task = self._by_key.get(key)
             if task is None:
                 continue
+            if getattr(task, "coded_group", None) is not None:
+                # Coded coverage members already carry pre-paid k-of-n
+                # redundancy; a speculative duplicate would double-spend
+                # AND race the coverage-settle cancellation on the same
+                # RUNNING task (the executor's speculate() refuses too —
+                # this skip just avoids burning the one-try-per-key
+                # budget on it).
+                continue
             self._tried.add(key)
             stats = self.planner.stats
             inv = getattr(task.name, "inv_index", None)

@@ -1,0 +1,263 @@
+"""Coded k-of-n redundant combines: proactive straggler tolerance.
+
+PR 16's ``spec`` policy is *reactive* duplication: a racing copy is
+dispatched only after a straggler is already late (and only when a
+free slot exists), so every wave still pays at least the detection
+latency. This module is the proactive half ROADMAP item 3 left open
+(PAPERS.md "Leveraging Coding Techniques for Speeding up Distributed
+Computing", Exoshuffle's plan-layer framing): at commutative-monoid
+combine boundaries — exactly the (shard, key) partial-combine contract
+the spill path already honors — the planner over-decomposes the map
+side into ``n = k + r`` coverage tasks whose partial aggregates are
+assigned in *striped coverage groups* so that any ``k`` of ``n``
+together cover every input unit exactly once. The consumer wave fires
+as soon as a covering subset settles; stragglers are cooperatively
+cancelled instead of raced; duplicate-coverage partials are masked
+before re-combine, so results stay bit-identical to the uncoded plan.
+
+Striping, not erasure codes: unit ``u``'s partial aggregate is
+replicated on owners ``{(u + j) mod n : j = 0..r}``. Each unit has
+``r + 1`` distinct owners, so ANY ``r`` task losses leave every unit
+with at least one surviving copy — the k-of-n property — while the
+monoid's determinism makes every copy byte-identical, which is what
+keeps bit-parity *provable* (the masked read picks any one copy; an
+erasure-coded aggregate would have to decode, and the decode result
+of floating-point partials is not the uncoded bytes).
+
+Cost model: total coverage work is ``k * (r + 1)`` units across ``n``
+tasks — redundancy is pre-paid and bounded at ``r/k`` extra work (the
+default ``r = ceil(k/8)`` is +12.5%), unlike speculation's unbounded
+reactive duplicates. Coding wins when stragglers are common enough
+that the k-th slowest task is much faster than the n-th (slow hosts,
+noisy neighbors); speculation wins when stragglers are rare and spare
+capacity is free. ``docs/robustness.md`` carries the full comparison.
+
+``BIGSLICE_CODED`` — unset (or ``off``) = fully disengaged: no planner
+object exists, the compiler emits the legacy task graph byte-identical
+(names, partition_config, program-cache keys), and zero
+``bigslice_coded_*`` telemetry samples are emitted — the same
+chicken-bit contract as BIGSLICE_ADAPTIVE / BIGSLICE_KERNEL_SELECT.
+``combine`` engages coding at combine boundaries.
+``BIGSLICE_CODED_REDUNDANCY`` overrides ``r`` (an integer ≥ 1).
+Unknown values fail loudly.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from bigslice_tpu.exec.task import TaskName
+
+#: The modes BIGSLICE_CODED accepts. ``combine`` = code the map side
+#: of commutative-monoid combine boundaries.
+MODES = ("off", "combine")
+
+#: Bounded decision log (newest kept), same shape as AdaptiveStats.
+MAX_DECISIONS = 256
+
+#: Smallest producer set worth coding: k=1 has no straggler to
+#: tolerate (the consumer waits on the only task either way).
+MIN_K = 2
+
+
+def plan_mode(env: Optional[str] = None) -> str:
+    """Parse ``BIGSLICE_CODED`` (or an explicit value). Unset/empty =
+    ``"off"``. Unknown values fail loudly — a typo'd knob silently
+    running the uncoded plan would defeat every A/B it exists for."""
+    if env is None:
+        env = os.environ.get("BIGSLICE_CODED", "")
+    env = env.strip().lower()
+    if not env:
+        return "off"
+    if env not in MODES:
+        raise ValueError(
+            f"BIGSLICE_CODED must be one of {'|'.join(MODES)}, "
+            f"got {env!r}"
+        )
+    return env
+
+
+def redundancy(k: int, env: Optional[str] = None) -> int:
+    """The redundancy ``r`` for a k-producer coverage group:
+    ``BIGSLICE_CODED_REDUNDANCY`` when set (≥ 1, fail loudly), else
+    ``ceil(k / 8)`` — +12.5% pre-paid work, tolerating one slow host
+    per eight."""
+    if env is None:
+        env = os.environ.get("BIGSLICE_CODED_REDUNDANCY", "")
+    env = env.strip()
+    if env:
+        try:
+            r = int(env)
+        except ValueError as e:
+            raise ValueError(
+                f"BIGSLICE_CODED_REDUNDANCY must be an integer ≥ 1, "
+                f"got {env!r}"
+            ) from e
+        if r < 1:
+            raise ValueError(
+                f"BIGSLICE_CODED_REDUNDANCY must be ≥ 1, got {r}"
+            )
+        return r
+    return max(1, math.ceil(k / 8))
+
+
+class CoverageGroup:
+    """One coded combine boundary: ``k`` input units over-decomposed
+    into ``n = k + r`` striped coverage tasks. The group is the shared
+    identity the compiler stamps on every member (``task.coded_group``)
+    and on the consumer's dep (``TaskDep.coded``); the evaluator keys
+    its k-of-n settle bookkeeping on it and the executor derives
+    per-unit store names from it."""
+
+    def __init__(self, inv_index: int, op: str, k: int, r: int):
+        self.inv_index = inv_index
+        self.op = op
+        self.k = int(k)
+        self.r = int(r)
+        self.n = self.k + self.r
+        # Filled by the compiler once the member tasks exist (the group
+        # must be constructed first so each member can carry it).
+        self.tasks: Tuple = ()
+
+    def owners(self, u: int) -> List[int]:
+        """The member indices owning unit ``u``'s partial aggregate,
+        preference-ordered (the masked read tries them in this order,
+        so every consumer deterministically prefers the same copy)."""
+        return [(u + j) % self.n for j in range(self.r + 1)]
+
+    def covers(self, i: int) -> List[int]:
+        """The units member ``i`` computes, ascending. Striping gives
+        each member at most ``r + 1`` units (fewer near the wrap,
+        since unit indices stop at ``k``)."""
+        return sorted(
+            u for j in range(self.r + 1)
+            if (u := (i - j) % self.n) < self.k
+        )
+
+    def cover_name(self, u: int, i: int) -> TaskName:
+        """The store name member ``i`` writes unit ``u``'s partial-
+        combine partitions under. Per-unit addressing is what makes
+        duplicate masking possible: the consumer picks ONE owner's
+        copy per unit instead of concatenating every member's
+        output."""
+        return TaskName(self.inv_index, f"{self.op}~cov{u}", i, self.n)
+
+    def __repr__(self) -> str:
+        return (f"CoverageGroup({self.op}, k={self.k}, r={self.r}, "
+                f"n={self.n})")
+
+
+class CodedStats:
+    """Attribution for the coded plane, shaped like AdaptiveStats: the
+    telemetry hub calls ``summary()`` / ``prometheus_lines()`` only
+    when a planner is attached, which is what guarantees zero
+    ``bigslice_coded_*`` samples with BIGSLICE_CODED unset."""
+
+    def __init__(self, mode: str, eventer=None):
+        self._lock = threading.Lock()
+        self.mode = mode
+        self._eventer = eventer
+        # action -> count. Actions: group (a boundary coded), covered
+        # (a covering k-subset settled), cancelled (a straggler member
+        # cooperatively cancelled), masked (a duplicate-coverage copy
+        # masked at consumer read), unit (a coverage unit computed),
+        # recovered (coverage re-established after a loss).
+        self._counts: Dict[str, int] = {}
+        self.decisions: List[dict] = []
+        self._t0 = time.monotonic()
+
+    def record(self, action: str, **detail) -> None:
+        """One coded-plane event: count it, log it (bounded), and emit
+        a ``bigslice:coded`` instant for slicetrace's ``invN:coded``
+        section. Never raises."""
+        entry = {"action": action,
+                 "t_s": round(time.monotonic() - self._t0, 6)}
+        entry.update({k: v for k, v in detail.items()
+                      if v is not None})
+        with self._lock:
+            self._counts[action] = self._counts.get(action, 0) + 1
+            self.decisions.append(entry)
+            if len(self.decisions) > MAX_DECISIONS:
+                del self.decisions[: len(self.decisions)
+                                   - MAX_DECISIONS]
+        ev = self._eventer
+        if ev is not None:
+            try:
+                ev("bigslice:coded", action=action,
+                   **{k: v for k, v in detail.items()
+                      if v is not None})
+            except Exception:
+                pass
+
+    def count(self, action: str) -> int:
+        with self._lock:
+            return self._counts.get(action, 0)
+
+    def summary(self) -> dict:
+        """The ``telemetry_summary()["coded"]`` payload."""
+        with self._lock:
+            return {
+                "mode": self.mode,
+                "counts": dict(sorted(self._counts.items())),
+                "decisions": [dict(d) for d in self.decisions],
+            }
+
+    def prometheus_lines(self, metric, line) -> None:
+        with self._lock:
+            counts = dict(self._counts)
+            mode = self.mode
+        metric("bigslice_coded_mode",
+               "Coded-combine mode engaged by BIGSLICE_CODED "
+               "(exec/codedplan.py); absent entirely when the knob "
+               "is unset.", "gauge")
+        line("bigslice_coded_mode", {"mode": mode}, 1)
+        metric("bigslice_coded_events_total",
+               "Coded k-of-n plane events: groups planned, coverage "
+               "settled, straggler members cancelled, duplicate "
+               "copies masked, units computed, coverage recovered "
+               "after loss.", "counter")
+        for action, n in sorted(counts.items()):
+            line("bigslice_coded_events_total", {"action": action}, n)
+
+
+class CodedPlanner:
+    """The compile-time decision maker: whether a combine boundary is
+    coded and with what ``(k, r)``. One per Session; the compiler and
+    evaluator consult it only where ``planner is not None`` — the
+    structural form of the chicken bit."""
+
+    def __init__(self, hub=None, mode: str = "combine"):
+        self.hub = hub
+        self.mode = mode
+        self.stats = CodedStats(
+            mode,
+            eventer=getattr(hub, "_emit", None) if hub is not None
+            else None,
+        )
+
+    def group_for(self, inv_index: int, op: str,
+                  k: int) -> Optional[CoverageGroup]:
+        """A CoverageGroup for a k-producer combine boundary, or None
+        when coding buys nothing (k < 2). The redundancy knob is read
+        per boundary so tests can vary it without a fresh planner."""
+        if self.mode != "combine" or k < MIN_K:
+            return None
+        r = redundancy(k)
+        grp = CoverageGroup(inv_index, op, k, r)
+        self.stats.record("group", op=op, inv=inv_index,
+                          k=k, r=r, n=grp.n)
+        return grp
+
+
+def planner_from_env(hub=None) -> Optional[CodedPlanner]:
+    """The session-construction entry point: a ``CodedPlanner`` when
+    BIGSLICE_CODED engages a mode, else None (callers hold
+    ``planner is None`` and run the legacy path untouched)."""
+    mode = plan_mode()
+    if mode == "off":
+        return None
+    return CodedPlanner(hub, mode)

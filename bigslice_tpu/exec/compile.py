@@ -59,8 +59,15 @@ _compiler_serial = _itertools.count(1)
 class Compiler:
     def __init__(self, inv_index: int, machine_combiners: bool = False,
                  mesh_signature=None, shuffle_mode=None,
-                 kernel_select_mode=None):
+                 kernel_select_mode=None, coded=None):
         self.inv_index = inv_index
+        # Coded k-of-n planner (exec/codedplan.py), frozen per
+        # compilation like the other plan knobs: the session resolves
+        # BIGSLICE_CODED once per run. None = knob unset — the compiler
+        # emits the legacy task graph byte-identically (names,
+        # partition_config, program-cache keys); a planner engages
+        # over-decomposition at commutative-monoid combine boundaries.
+        self.coded = coded
         # Kernel auto-selection knob (parallel/kernelselect.py), frozen
         # per compilation like shuffle_mode: the session resolves
         # BIGSLICE_KERNEL_SELECT once per run and stamps the mode into
@@ -189,15 +196,28 @@ class Compiler:
             op_name = f"{op_name}~{seen}"
 
         slice_names = [str(s.name) for s in chain]
-        tasks: List[Task] = []
-        for shard in range(num_tasks):
-            deps = []
+
+        def deps_for_shard(shard: int) -> List[TaskDep]:
+            """The TaskDeps the uncoded task at ``shard`` reads — also
+            the per-unit dep slice of a coded coverage member, which is
+            why this is a closure and not inline in the shard loop."""
+            deps: List[TaskDep] = []
             for dep_tasks, dep, dep_part in dep_task_lists:
                 if dep.shuffle:
+                    # A producer set the coded planner over-decomposed
+                    # carries its CoverageGroup on every member: the
+                    # consumer reads the masked k-of-n view and the
+                    # evaluator settles the dep at coverage, not at
+                    # all-n-OK. None on every legacy producer.
+                    nested = (
+                        getattr(dep_tasks[0], "coded_group", None)
+                        if dep_tasks else None
+                    )
                     deps.append(
                         TaskDep(
                             tuple(dep_tasks), shard, expand=dep.expand,
                             combine_key=dep_part.combine_key,
+                            coded=nested,
                         )
                     )
                 elif dep.broadcast:
@@ -208,11 +228,49 @@ class Compiler:
                 else:
                     # Aligned read: shard i reads dep shard i's partition 0.
                     deps.append(TaskDep((dep_tasks[shard],), 0))
+            return deps
+
+        # Repr-stable partition-config descriptor (no ids): the
+        # device-plane compile telemetry keys cost/memory attribution on
+        # (op, partition config), and the AOT compiled-program cache
+        # keys on the same shape (registry digest + partition config).
+        # Plan-knob stamps append only when their planner is engaged,
+        # so unset-knob runs keep the legacy shape — and byte-identical
+        # digests — exactly.
+        base_config = (
+            part.num_partition,
+            bool(part.combiner),
+            bool(part.partition_fn),
+            self.mesh_signature,
+        )
+        if self.kernel_select_mode is not None:
+            base_config += ("kselect:" + self.kernel_select_mode,)
+
+        grp = None
+        if (self.coded is not None and part.combiner is not None
+                and not part.combine_key):
+            # Coded k-of-n boundary candidate: a commutative-monoid
+            # map-side combine (the consumer's combiner wired into this
+            # producer's partitioner) WITHOUT a machine-combiner buffer
+            # — per-task partials are exactly what striped coverage
+            # replicates. group_for declines k < 2.
+            grp = self.coded.group_for(self.inv_index, op_name,
+                                       num_tasks)
+        if grp is not None:
+            tasks = self._compile_coded(
+                grp, chain, part, slice_, key, op_name, slice_names,
+                deps_for_shard, base_config,
+            )
+            self._memo[key] = tasks
+            return tasks
+
+        tasks: List[Task] = []
+        for shard in range(num_tasks):
             name = TaskName(self.inv_index, op_name, shard, num_tasks)
             task = Task(
                 name=name,
                 do=_make_do(chain, shard),
-                deps=deps,
+                deps=deps_for_shard(shard),
                 partitioner=part,
                 schema=slice_.schema,
                 procs=slice_.procs,
@@ -223,24 +281,7 @@ class Compiler:
             # groups (the mesh executor runs all shards of a fused chain
             # as one SPMD program).
             task.chain = chain
-            # Repr-stable partition-config descriptor (no ids): the
-            # device-plane compile telemetry keys cost/memory
-            # attribution on (op, partition config), and ROADMAP item
-            # 3's AOT compiled-program cache will key on the same
-            # shape (registry digest + partition config).
-            task.partition_config = (
-                part.num_partition,
-                bool(part.combiner),
-                bool(part.partition_fn),
-                self.mesh_signature,
-            )
-            if self.kernel_select_mode is not None:
-                # Appended only when the selector is engaged: the
-                # unset-knob descriptor stays the legacy 4-tuple, so
-                # chicken-bit runs keep byte-identical digests.
-                task.partition_config += (
-                    "kselect:" + self.kernel_select_mode,
-                )
+            task.partition_config = base_config
             # Shuffle-plan stamps (exec/shuffleplan.py): the frozen
             # static knob, plus the compile-time spill-eligibility
             # verdict — machine-combined boundaries share one combiner
@@ -257,6 +298,58 @@ class Compiler:
             task.group_key = (self.inv_index, op_name, self.serial, key)
             tasks.append(task)
         self._memo[key] = tasks
+        return tasks
+
+    def _compile_coded(self, grp, chain, part, slice_, key, op_name,
+                       slice_names, deps_for_shard, base_config
+                       ) -> List[Task]:
+        """Emit the ``n = k + r`` striped coverage members for one coded
+        combine boundary (exec/codedplan.py). Member ``i`` computes
+        units ``grp.covers(i)`` — unit ``u`` is byte-for-byte the work
+        the uncoded task at shard ``u`` would have done (same do
+        closure, same deps, same partition+combine) — and stores each
+        unit's partitions under ``grp.cover_name(u, i)``, so consumers
+        mask duplicates by reading exactly one owner's copy per unit."""
+        tasks: List[Task] = []
+        for i in range(grp.n):
+            deps: List[TaskDep] = []
+            units = []
+            for u in grp.covers(i):
+                lo = len(deps)
+                deps.extend(deps_for_shard(u))
+                # (unit, do, dep_lo, dep_hi): the executor slices the
+                # member's dep-reader factories back apart per unit.
+                units.append((u, _make_do(chain, u), lo, len(deps)))
+            name = TaskName(
+                self.inv_index, f"{op_name}~k{grp.k}r{grp.r}", i, grp.n
+            )
+            task = Task(
+                name=name,
+                do=_coded_body_unused,
+                deps=deps,
+                partitioner=part,
+                schema=slice_.schema,
+                procs=slice_.procs,
+                exclusive=slice_.exclusive,
+                slice_names=slice_names,
+            )
+            task.chain = chain
+            task.coded_group = grp
+            task.coded_units = units
+            # The coded stamp keeps engaged-plan digests (and AOT cache
+            # keys) disjoint from legacy plans, same discipline as the
+            # kselect stamp; unset-knob compilations never reach this
+            # method.
+            task.partition_config = base_config + (
+                f"coded:k{grp.k}r{grp.r}",
+            )
+            task.shuffle_mode = self.shuffle_mode
+            # Per-unit outputs live under cover names the spill ledger
+            # does not track; coverage members always run whole.
+            task.spill_ineligible = "coded coverage partials"
+            task.group_key = (self.inv_index, op_name, self.serial, key)
+            tasks.append(task)
+        grp.tasks = tuple(tasks)
         return tasks
 
     def _compile_result(self, result, slice_: Slice,
@@ -363,6 +456,18 @@ def _identity_do():
         return dep_factories[0]()
 
     return do
+
+
+def _coded_body_unused(dep_factories):
+    # Coded coverage members run per-unit through the executor's
+    # _execute_coded path (each unit has its own do closure in
+    # task.coded_units); reaching the task-level body means an executor
+    # missed the coded branch — fail loudly rather than compute one
+    # unit's worth and silently drop the rest.
+    raise RuntimeError(
+        "coded coverage task body must run via _execute_coded, "
+        "not task.do"
+    )
 
 
 def compile_slice(slice_: Slice, inv_index: int = 1) -> List[Task]:

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import collections
 import threading
+import time
 from typing import Dict, List, Optional, Sequence
 
 from bigslice_tpu.exec.task import (
@@ -36,6 +37,21 @@ from bigslice_tpu.exec.task import (
 from bigslice_tpu.utils import faultinject
 
 MAX_CONSECUTIVE_LOST = 5  # exec/eval.go:30
+
+
+class DeadlineExceeded(Exception):
+    """The evaluation's deadline expired before the roots settled.
+    In-flight tasks were cooperatively cancelled and drained (bounded)
+    before this raised, so the executor's slots are already free —
+    the serving plane's 504 path relies on that ordering."""
+
+    def __init__(self, deadline_s: float, pending: int):
+        self.deadline_s = deadline_s
+        self.pending = pending
+        super().__init__(
+            f"evaluation deadline ({deadline_s:.3f}s) exceeded with "
+            f"{pending} task(s) unfinished"
+        )
 
 # Executor phase markers for the overlapped wave pipeline
 # (exec/meshexec.py): emitted when a wave's inputs finish staging on the
@@ -70,13 +86,33 @@ def notify_phase(monitor, task, phase: str, wave: int) -> None:
 # fail loudly rather than hang. Coarse on purpose.
 SWEEP_SECS = 5.0
 
+# States a task may be (re)submitted from. CANCELLED is here by design:
+# a cooperatively-cancelled task (coded redundancy, deadline abort) is
+# not fatal — it resubmits cleanly if a later evaluation (or a coverage
+# loss) makes it needed again. The coded-member exception — don't
+# resubmit a member whose group is already covered — is enforced by
+# ``_Evaluation._wanted``, not by the state set.
+_RESUBMITTABLE = (TaskState.INIT, TaskState.LOST, TaskState.CANCELLED)
 
-def evaluate(executor, roots: Sequence[Task], monitor=None) -> None:
+# Bounded drain after a deadline abort: cancellation is cooperative, so
+# give bodies a short window to reach a seam before reporting.
+DEADLINE_DRAIN_SECS = 10.0
+
+
+def evaluate(executor, roots: Sequence[Task], monitor=None,
+             deadline: Optional[float] = None) -> None:
     """Evaluate the graph rooted at ``roots`` to completion.
 
     ``executor`` implements ``submit(task)`` (async: eventually moves the
     task from WAITING to a terminal state). ``monitor``, if given, receives
     ``(task, state)`` transition callbacks (status displays, tracing).
+
+    ``deadline``, if given, is an ABSOLUTE ``time.monotonic()`` stamp:
+    when it passes before the roots settle, every in-flight task is
+    cooperatively cancelled (flag + event; executors honor it at their
+    frame/unit/wave seams), the cancellations are drained (bounded),
+    and ``DeadlineExceeded`` raises. Cancelled tasks stay resubmittable
+    — a later evaluation of the same graph re-runs them.
 
     When the executor carries an adaptive planner (exec/adaptive.py,
     attached by the Session under BIGSLICE_ADAPTIVE), the spec policy's
@@ -85,7 +121,7 @@ def evaluate(executor, roots: Sequence[Task], monitor=None) -> None:
     flagged tasks through ``executor.speculate``. With the knob unset
     ``executor.adaptive`` is None and this path adds nothing.
     """
-    ev = _Evaluation(executor, roots, monitor)
+    ev = _Evaluation(executor, roots, monitor, deadline=deadline)
     planner = getattr(executor, "adaptive", None)
     watcher = None
     if planner is not None:
@@ -101,10 +137,11 @@ def evaluate(executor, roots: Sequence[Task], monitor=None) -> None:
 
 
 class _Evaluation:
-    def __init__(self, executor, roots, monitor):
+    def __init__(self, executor, roots, monitor, deadline=None):
         self.executor = executor
         self.roots = list(roots)
         self.monitor = monitor
+        self.deadline = deadline
         self.tasks = iter_tasks(roots)
         self.cond = threading.Condition()
         self.events: collections.deque = collections.deque()
@@ -114,6 +151,14 @@ class _Evaluation:
         }
         self.dep_counts: Dict[int, int] = {}
         self.ok_seen: set = set()  # dep ids currently credited as OK
+        # Coded k-of-n coverage groups (exec/codedplan.py): a coded dep
+        # contributes ONE pending credit per group, released when every
+        # unit has at least one OK owner — NOT when all n members are
+        # OK; that early release is the whole feature. All empty with
+        # BIGSLICE_CODED unset (no compiled task carries a group then).
+        self.groups: Dict[int, object] = {}          # gid -> group
+        self.group_consumers: Dict[int, List[Task]] = {}
+        self.group_covered: Dict[int, bool] = {}
 
     def _wake(self, task: Task, state: TaskState) -> None:
         if self.monitor is not None:
@@ -154,22 +199,158 @@ class _Evaluation:
                 self.ok_seen.add(id(t))
         ready = []
         for t in self.tasks:
-            deps = t.all_dep_tasks()
             pending = 0
-            for d in deps:
-                self.consumers[id(d)].append(t)
-                if snapshot[id(d)] != TaskState.OK:
-                    pending += 1
+            for dep in t.deps:
+                grp = getattr(dep, "coded", None)
+                if grp is not None:
+                    # One credit per coverage group: released by the
+                    # k-of-n settle, not by n individual OKs. Member
+                    # transitions are routed group-aware in _on_event.
+                    gid = id(grp)
+                    if gid not in self.groups:
+                        self.groups[gid] = grp
+                        self.group_covered[gid] = self._covered(grp)
+                    self.group_consumers.setdefault(gid, []).append(t)
+                    if not self.group_covered[gid]:
+                        pending += 1
+                    continue
+                for d in dep.tasks:
+                    self.consumers[id(d)].append(t)
+                    if snapshot[id(d)] != TaskState.OK:
+                        pending += 1
             self.dep_counts[id(t)] = pending
-            if pending == 0 and snapshot[id(t)] in (TaskState.INIT,
-                                                    TaskState.LOST):
-                ready.append(t)
+            if pending == 0 and snapshot[id(t)] in _RESUBMITTABLE:
+                if self._wanted(t):
+                    ready.append(t)
         return ready
+
+    # -- coded coverage groups (exec/codedplan.py) ------------------------
+
+    @staticmethod
+    def _covered(grp) -> bool:
+        """Does a covering k-subset of the group's members hold OK
+        partials for every unit right now? O(k * (r+1)) state reads —
+        cheap next to any task body."""
+        tasks = grp.tasks
+        return all(
+            any(tasks[oi].state == TaskState.OK for oi in grp.owners(u))
+            for u in range(grp.k)
+        )
+
+    @staticmethod
+    def _coverable(grp) -> bool:
+        """Can coverage still be reached WITHOUT resubmitting anyone —
+        i.e. does every unit have at least one owner that is OK or
+        still on its way (INIT/WAITING/RUNNING)? Losses within the r
+        budget keep this true, which is exactly the silent case: the
+        design point of the stripe is that up to r members may die
+        with no recompute. Only when a unit's every owner is dead
+        (LOST/CANCELLED/ERR) does the loud resubmission ladder fire."""
+        live = (TaskState.OK, TaskState.INIT, TaskState.WAITING,
+                TaskState.RUNNING)
+        tasks = grp.tasks
+        return all(
+            any(tasks[oi].state in live for oi in grp.owners(u))
+            for u in range(grp.k)
+        )
+
+    def _wanted(self, task: Task) -> bool:
+        """Is re-running ``task`` useful? False only for a coded member
+        whose group is already covered — its output is redundant, and
+        resubmitting it would undo the cancellation that coverage just
+        bought."""
+        grp = getattr(task, "coded_group", None)
+        if grp is None:
+            return True
+        return not self.group_covered.get(id(grp), False)
+
+    def _coded_stats(self):
+        planner = getattr(self.executor, "coded", None)
+        return getattr(planner, "stats", None)
+
+    def _cancel_redundant(self, grp) -> None:
+        """Coverage settled: cooperatively cancel the members still in
+        flight (their output is now redundant). WAITING members flip
+        straight to CANCELLED (the executor's RUNNING claim CAS finds
+        the state changed and drops them); RUNNING members get the
+        flag and stop at their next seam. The RUNNING→OK vs
+        RUNNING→CANCELLED race is settled by the task state machine's
+        transition_if — first transition wins, both outcomes are
+        correct (a straggler that finishes anyway is just a masked
+        duplicate)."""
+        stats = self._coded_stats()
+        for m in grp.tasks:
+            st = m.state
+            if st not in (TaskState.WAITING, TaskState.RUNNING):
+                continue
+            m.request_cancel()
+            if m.transition_if(TaskState.WAITING, TaskState.CANCELLED):
+                st = TaskState.CANCELLED
+            if stats is not None:
+                stats.record("cancelled", task=str(m.name),
+                             op=grp.op, state=st.name)
+
+    def _on_coded_event(self, grp, task: Task, state: TaskState,
+                        ready: List[Task]) -> None:
+        """Group-aware transition handling for a coverage member."""
+        gid = id(grp)
+        if state == TaskState.OK:
+            if not self.group_covered.get(gid, False) \
+                    and self._covered(grp):
+                self.group_covered[gid] = True
+                stats = self._coded_stats()
+                if stats is not None:
+                    stats.record("covered", op=grp.op, k=grp.k,
+                                 r=grp.r, inv=grp.inv_index)
+                for c in self.group_consumers.get(gid, ()):
+                    cid = id(c)
+                    self.dep_counts[cid] -= 1
+                    if self.dep_counts[cid] == 0 and \
+                            c.state in _RESUBMITTABLE:
+                        ready.append(c)
+                self._cancel_redundant(grp)
+        elif state == TaskState.LOST:
+            if self.group_covered.get(gid, False) \
+                    and not self._covered(grp):
+                # A previously-covering member was lost: re-charge the
+                # consumers and re-own the uncovered units (cancelled
+                # siblings become needed again).
+                self.group_covered[gid] = False
+                for c in self.group_consumers.get(gid, ()):
+                    self.dep_counts[id(c)] += 1
+                stats = self._coded_stats()
+                if stats is not None:
+                    stats.record("coverage_lost", op=grp.op,
+                                 task=str(task.name))
+            if not self.group_covered.get(gid, False) \
+                    and not self._coverable(grp):
+                # Losses exceeded the stripe's r budget: some unit has
+                # no live owner left. Resubmit the dead members — the
+                # loud recompute path, recorded as 'recovered' (within
+                # the budget this branch never runs: the silent case).
+                stats = self._coded_stats()
+                for m in grp.tasks:
+                    if m.state in _RESUBMITTABLE and \
+                            self.dep_counts.get(id(m), 1) == 0:
+                        ready.append(m)
+                        if stats is not None:
+                            stats.record("recovered", op=grp.op,
+                                         task=str(m.name))
 
     def _on_event(self, task: Task, state: TaskState,
                   ready: List[Task]) -> Optional[Task]:
         """Update counts for one transition; append newly submittable
         tasks to ``ready``. Returns an ERR task if one surfaced."""
+        if state == TaskState.ERR:
+            return task
+        grp = getattr(task, "coded_group", None)
+        if grp is not None and id(grp) in self.groups:
+            # Coverage members settle at the GROUP level (one credit
+            # per group, released by the k-of-n cover), so their
+            # transitions never flow through the per-task ok_seen
+            # ledger below.
+            self._on_coded_event(grp, task, state, ready)
+            return None
         tid = id(task)
         if state == TaskState.OK:
             if tid not in self.ok_seen:
@@ -177,9 +358,8 @@ class _Evaluation:
                 for c in self.consumers.get(tid, ()):
                     cid = id(c)
                     self.dep_counts[cid] -= 1
-                    if self.dep_counts[cid] == 0 and c.state in (
-                        TaskState.INIT, TaskState.LOST
-                    ):
+                    if self.dep_counts[cid] == 0 and \
+                            c.state in _RESUBMITTABLE:
                         ready.append(c)
         elif state == TaskState.LOST:
             if tid in self.ok_seen:
@@ -189,14 +369,14 @@ class _Evaluation:
                     self.dep_counts[id(c)] += 1
             if self.dep_counts.get(tid, 1) == 0:
                 ready.append(task)
-        elif state == TaskState.ERR:
-            return task
         return None
 
     def _submit(self, task: Task) -> bool:
         """Submit if still runnable; enforce the consecutive-loss cap."""
         st = task.state
-        if st not in (TaskState.INIT, TaskState.LOST):
+        if st not in _RESUBMITTABLE:
+            return False
+        if not self._wanted(task):
             return False
         if task.consecutive_lost >= MAX_CONSECUTIVE_LOST:
             task.set_state(
@@ -217,13 +397,43 @@ class _Evaluation:
                 task.mark_lost(faultinject.injected_error(fault))
                 return False
         if task.transition_if(st, TaskState.WAITING):
+            if st == TaskState.CANCELLED:
+                # Fresh attempt: the stale cancellation request must
+                # not kill the resubmitted run at its first seam.
+                task.clear_cancel()
             self.executor.submit(task)
             return True
         return False
 
     # -- the loop ----------------------------------------------------------
 
+    def _remaining(self) -> Optional[float]:
+        if self.deadline is None:
+            return None
+        return self.deadline - time.monotonic()
+
+    def _expire(self) -> None:
+        """The deadline passed: cancel everything in flight (flag +
+        event, WAITING flips straight to CANCELLED), drain (bounded —
+        cancellation is cooperative), and raise DeadlineExceeded with
+        the pending census. Slots are free by the time this raises."""
+        for t in self.tasks:
+            st = t.state
+            if st in (TaskState.WAITING, TaskState.RUNNING):
+                t.request_cancel()
+                t.transition_if(TaskState.WAITING, TaskState.CANCELLED)
+        self._drain(timeout=DEADLINE_DRAIN_SECS)
+        pending = sum(
+            1 for t in self.tasks if t.state != TaskState.OK
+        )
+        raise DeadlineExceeded(
+            deadline_s=0.0 if self.deadline is None else
+            max(0.0, self.deadline - getattr(self, "_t0", self.deadline)),
+            pending=pending,
+        )
+
     def _run(self) -> None:
+        self._t0 = time.monotonic()
         with self.cond:
             ready = self._build()
         # A task already fatal when evaluation starts (e.g. failed under
@@ -233,17 +443,32 @@ class _Evaluation:
             (t for t in self.tasks if t.state == TaskState.ERR), None
         )
         while True:
+            remaining = self._remaining()
+            if remaining is not None and remaining <= 0:
+                self._expire()
             # Submit outside the lock (executors may call back inline).
             for t in ready:
                 self._submit(t)
             ready = []
+            expired = False
             with self.cond:
                 while not self.events:
                     if all(r.state == TaskState.OK for r in self.roots):
                         return
                     if err_task is not None:
                         break
-                    if not self.cond.wait(timeout=SWEEP_SECS):
+                    timeout = SWEEP_SECS
+                    remaining = self._remaining()
+                    if remaining is not None:
+                        if remaining <= 0:
+                            expired = True
+                            break
+                        timeout = min(SWEEP_SECS, remaining)
+                    if not self.cond.wait(timeout=timeout):
+                        remaining = self._remaining()
+                        if remaining is not None and remaining <= 0:
+                            expired = True
+                            break
                         self._sweep(ready)
                         if ready:
                             break
@@ -252,20 +477,37 @@ class _Evaluation:
                     bad = self._on_event(task, state, ready)
                     if bad is not None and err_task is None:
                         err_task = bad
+            if expired:
+                self._expire()
             if err_task is not None:
                 self._drain()
                 raise TaskError(
                     err_task, err_task.error or RuntimeError("task error")
                 )
 
+    def _deps_satisfied(self, task: Task) -> bool:
+        """Per-dep satisfaction from live state: a coded dep is
+        satisfied by coverage (any k of n), every other dep by all of
+        its producers being OK. The sweep must NOT require all n coded
+        members OK — cancelled stragglers are the steady state of a
+        covered group, not a stall."""
+        for dep in task.deps:
+            grp = getattr(dep, "coded", None)
+            if grp is not None:
+                if not self._covered(grp):
+                    return False
+                continue
+            if any(d.state != TaskState.OK for d in dep.tasks):
+                return False
+        return True
+
     def _sweep(self, ready: List[Task]) -> None:
         """Safety net: after a quiet interval, re-derive submittable
         tasks from scratch and fail loudly on a true stall (a cycle or
         an executor that dropped a task silently)."""
         for t in self.tasks:
-            if t.state in (TaskState.INIT, TaskState.LOST) and all(
-                d.state == TaskState.OK for d in t.all_dep_tasks()
-            ):
+            if t.state in _RESUBMITTABLE and self._wanted(t) \
+                    and self._deps_satisfied(t):
                 ready.append(t)
         if ready:
             return

@@ -23,7 +23,8 @@ import numpy as np
 from bigslice_tpu import sliceio
 from bigslice_tpu.frame.frame import Frame
 from bigslice_tpu.exec import store as store_mod
-from bigslice_tpu.exec.task import Task, TaskState
+from bigslice_tpu.exec.task import Task, TaskCancelled, TaskState
+from bigslice_tpu.utils import faultinject
 from bigslice_tpu.utils import metrics as metrics_mod
 
 
@@ -197,6 +198,13 @@ class LocalExecutor:
 
     def discard(self, task: Task) -> None:
         self.store.discard(task.name)
+        # Coded coverage members store per-unit partials under cover
+        # names (the task's own entries are committed empty); both must
+        # go or a rerun would serve stale coverage.
+        grp = getattr(task, "coded_group", None)
+        if grp is not None:
+            for u, _do, _lo, _hi in getattr(task, "coded_units", ()):
+                self.store.discard(grp.cover_name(u, task.name.shard))
         # Free machine-combiner buffers this task consumed.
         with self._mc_lock:
             for dep in task.deps:
@@ -211,6 +219,9 @@ class LocalExecutor:
     # -- task execution ----------------------------------------------------
 
     def _dep_factory(self, dep):
+        grp = getattr(dep, "coded", None)
+        if grp is not None:
+            return self._coded_dep_factory(dep, grp)
         if dep.combine_key:
             # Machine-combined dep: one shared, already-combined buffer
             # per partition (read once, not per producer task). A missing
@@ -293,15 +304,93 @@ class LocalExecutor:
 
         return factory
 
+    def _coded_stats(self):
+        planner = getattr(self, "coded", None)
+        return getattr(planner, "stats", None)
+
+    def _coded_dep_factory(self, dep, grp):
+        """Masked k-of-n read of a coded coverage group (exec/
+        codedplan.py): for each unit, stream exactly ONE owner's copy —
+        the first owner (in the group's deterministic preference order)
+        whose store entry exists — so duplicate coverage is masked and
+        the consumer sees the byte-identical frame sequence the uncoded
+        plan would have produced (unit u's copy IS uncoded shard u's
+        output). A unit with no surviving copy is a lost dep: the
+        evaluator re-runs that unit's owners and coverage recovers."""
+
+        def factory():
+            stats = self._coded_stats()
+
+            def gen():
+                for u in range(grp.k):
+                    owners = grp.owners(u)
+                    served = None
+                    for oi in owners:
+                        try:
+                            reader = self.store.read(
+                                grp.cover_name(u, oi), dep.partition
+                            )
+                        except store_mod.Missing:
+                            continue
+                        served = oi
+                        try:
+                            yield from reader
+                        except store_mod.Missing as e:
+                            # Mid-stream loss: rows already yielded, so
+                            # falling to another owner would duplicate
+                            # them — lose the unit's owners and re-run.
+                            raise DepLost(
+                                grp.tasks[oi],
+                                all_producers=[grp.tasks[j]
+                                               for j in owners],
+                            ) from e
+                        break
+                    if served is None:
+                        raise DepLost(
+                            grp.tasks[owners[0]],
+                            all_producers=[grp.tasks[j] for j in owners],
+                        )
+                    if stats is not None and served is not None:
+                        dup = sum(
+                            1 for oj in owners
+                            if oj != served
+                            and grp.tasks[oj].state == TaskState.OK
+                        )
+                        if dup:
+                            stats.record("masked", op=grp.op, unit=u,
+                                         extra_copies=dup)
+
+            return gen()
+
+        return factory
+
     def _run(self, task: Task) -> None:
         permits = self._limiter.capacity if task.exclusive else task.procs
         self._limiter.acquire(permits)
         try:
             if not task.transition_if(TaskState.WAITING, TaskState.RUNNING):
                 return  # another evaluation claimed it
+            if faultinject.ENABLED:
+                # Chaos seam AFTER the RUNNING claim, so both arms of a
+                # coded/speculation A/B traverse it identically: 'slow'
+                # delays the body (a reproducible straggler host),
+                # 'stuck' wedges until cancelled, 'lose' drops the run
+                # into the LOST resubmit ladder.
+                fault = faultinject.fire("task.run")
+                fault = faultinject.absorb_slow_or_stuck(fault, task)
+                if fault is not None:
+                    raise faultinject.injected_error(fault)
             with metrics_mod.scope_context(task.scope):
                 self._execute(task)
             task.mark_ok()
+        except TaskCancelled:
+            # Cooperative cancellation (coded coverage settled, deadline
+            # expired): CANCELLED only if still RUNNING — losing the CAS
+            # means another path already settled the task (e.g. a
+            # speculative duplicate won RUNNING→OK; its result stands).
+            task.transition_if(TaskState.RUNNING, TaskState.CANCELLED)
+        except faultinject.InjectedLoss as e:
+            task.mark_lost(e)
         except DepLost as e:
             # A dependency's output vanished: this run is lost, and so are
             # the producing task(s) — the evaluator re-runs producers
@@ -335,6 +424,12 @@ class LocalExecutor:
         if not getattr(task, "_local_tier", False):
             return False
         if task.exclusive or task.partitioner.combine_key:
+            return False
+        if getattr(task, "coded_group", None) is not None:
+            # Coverage members already carry pre-paid redundancy (any k
+            # of n suffice); racing a duplicate would double-spend, and
+            # worse, collide with coverage cancellation on the same
+            # RUNNING task.
             return False
         if task.state != TaskState.RUNNING:
             return False
@@ -392,6 +487,9 @@ class LocalExecutor:
 
     def _execute(self, task: Task,
                  record_telemetry: bool = True) -> None:
+        if getattr(task, "coded_units", None):
+            self._execute_coded(task, record_telemetry=record_telemetry)
+            return
         spillers: List[Optional[object]] = []
         try:
             self._execute_inner(task, spillers,
@@ -403,6 +501,111 @@ class LocalExecutor:
                 if sp is not None:
                     sp.cleanup()
 
+    def _execute_coded(self, task: Task,
+                       record_telemetry: bool = True) -> None:
+        """Run a coded coverage member: each unit in task.coded_units is
+        byte-for-byte the work of one uncoded shard (its own do closure
+        over its own dep slice), partitioned and combined with the same
+        partitioner, stored under the group's per-unit cover name so
+        consumers can mask duplicates. Units run serially with
+        cancellation seams between frames and between units — a member
+        made redundant by coverage stops at the next seam instead of
+        finishing work nobody will read."""
+        grp = task.coded_group
+        comb = task.combiner
+        nparts = task.num_partition
+        stats = self._coded_stats()
+        for u, do_u, lo, hi in task.coded_units:
+            task.check_cancel()
+            if faultinject.ENABLED:
+                # Per-unit chaos seam (only reachable when the coded
+                # plane is engaged): 'lose' drops the member into the
+                # LOST ladder mid-coverage — the k-of-n test bed.
+                fault = faultinject.fire("coded.cover")
+                fault = faultinject.absorb_slow_or_stuck(fault, task)
+                if fault is not None:
+                    raise faultinject.injected_error(fault)
+            factories = [self._dep_factory(d)
+                         for d in task.deps[lo:hi]]
+            reader = do_u(factories)
+            parts: List[List[Frame]] = [[] for _ in range(nparts)]
+            pending_rows = [0] * nparts
+            flush_at = [COMBINE_FLUSH_ROWS] * nparts
+            routed_rows = [0] * nparts
+            routed_bytes = [0] * nparts
+            in_rows = 0
+            for frame in reader:
+                if not len(frame):
+                    continue
+                task.check_cancel()
+                in_rows += len(frame)
+                ids = task.partitioner.partition_ids(frame, nparts)
+                for p, sub in enumerate(
+                        partition_frame(frame, ids, nparts)):
+                    if not len(sub):
+                        continue
+                    routed_rows[p] += len(sub)
+                    routed_bytes[p] += sum(
+                        int(getattr(c, "nbytes", 0) or 0)
+                        for c in getattr(sub, "cols", ())
+                    )
+                    parts[p].append(sub)
+                    pending_rows[p] += len(sub)
+                    if pending_rows[p] >= flush_at[p]:
+                        combined = comb.combine_frames(parts[p])
+                        parts[p] = [combined] if len(combined) else []
+                        pending_rows[p] = len(combined)
+                        flush_at[p] = max(COMBINE_FLUSH_ROWS,
+                                          2 * len(combined))
+            name = grp.cover_name(u, task.name.shard)
+            out_rows = 0
+            for p in range(nparts):
+                out = comb.combine_frames(parts[p])
+                out_rows += len(out)
+                self.store.put(name, p, [out] if len(out) else [])
+            if record_telemetry:
+                # Attributed to the LOGICAL op (grp.op, the uncoded
+                # name): the coded planner's k/n sizing and the kernel
+                # selector's probe corpora want the boundary's true
+                # cardinality regardless of which plan computed it.
+                self._record_combine_input(
+                    grp.op, task.name.inv_index, in_rows, out_rows
+                )
+                if nparts > 1:
+                    hub = getattr(getattr(self, "session", None),
+                                  "telemetry", None)
+                    if hub is not None:
+                        try:
+                            hub.record_shuffle(grp.op,
+                                               task.name.inv_index,
+                                               routed_rows,
+                                               routed_bytes)
+                        except Exception:
+                            pass
+            if stats is not None:
+                stats.record("unit", op=grp.op, unit=u,
+                             member=task.name.shard, rows=in_rows)
+        # The member's OWN store entries commit empty (the machine-
+        # combine precedent): consumers read through the masked per-unit
+        # cover path, and an empty commit keeps generic store
+        # bookkeeping (discard, presence checks) working.
+        for p in range(nparts):
+            self.store.put(task.name, p, [])
+
+    def _record_combine_input(self, op: str, inv_index: int,
+                              in_rows: int, out_rows: int) -> None:
+        """Report a map-side combine boundary's TRUE input cardinality
+        (rows in, distinct-ish rows out) to the telemetry hub — the
+        post-combine shuffle sizes alone hide it. Best-effort."""
+        hub = getattr(getattr(self, "session", None), "telemetry",
+                      None)
+        if hub is None:
+            return
+        try:
+            hub.record_combine_input(op, inv_index, in_rows, out_rows)
+        except Exception:
+            pass
+
     def _execute_inner(self, task: Task, spillers,
                        record_telemetry: bool = True) -> None:
         factories = [self._dep_factory(d) for d in task.deps]
@@ -410,8 +613,16 @@ class LocalExecutor:
         nparts = task.num_partition
         if nparts <= 1 and task.combiner is None:
             # Streamed: a streaming store (FileStore) writes batch by
-            # batch without materializing the shard.
-            self.store.put(task.name, 0, (f for f in reader if len(f)))
+            # batch without materializing the shard. The generator
+            # carries the cancellation seam — a deadline abort stops the
+            # stream at the next batch instead of finishing the shard.
+            def _stream():
+                for f in reader:
+                    task.check_cancel()
+                    if len(f):
+                        yield f
+
+            self.store.put(task.name, 0, _stream())
             return
         parts: List[List[Frame]] = [[] for _ in range(nparts)]
         pending_rows = [0] * nparts
@@ -426,6 +637,7 @@ class LocalExecutor:
         for frame in reader:
             if not len(frame):
                 continue
+            task.check_cancel()
             ids = task.partitioner.partition_ids(frame, nparts)
             for p, sub in enumerate(partition_frame(frame, ids, nparts)):
                 if len(sub):
@@ -474,9 +686,11 @@ class LocalExecutor:
         if comb is not None and ck:
             self._machine_combine(task, parts)
             return
+        combined_out_rows = 0
         for p in range(nparts):
             if comb is not None:
                 out = comb.combine_frames(parts[p])
+                combined_out_rows += len(out)
                 frames = [out] if len(out) else []
             elif spillers[p] is not None:
                 # Stream spilled runs + the in-memory tail into the
@@ -496,6 +710,11 @@ class LocalExecutor:
             else:
                 frames = parts[p]
             self.store.put(task.name, p, frames)
+        if comb is not None and record_telemetry:
+            self._record_combine_input(
+                task.name.op, task.name.inv_index,
+                sum(routed_rows), combined_out_rows,
+            )
 
     def _machine_combine(self, task: Task, parts: List[List[Frame]]) -> None:
         """Contribute this shard's partitioned output to the shared

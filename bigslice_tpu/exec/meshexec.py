@@ -50,7 +50,12 @@ from bigslice_tpu.exec.evaluate import (
     notify_phase,
 )
 from bigslice_tpu.exec.local import DepLost, LocalExecutor
-from bigslice_tpu.exec.task import Task, TaskName, TaskState
+from bigslice_tpu.exec.task import (
+    Task,
+    TaskCancelled,
+    TaskName,
+    TaskState,
+)
 from bigslice_tpu.parallel import segment
 from bigslice_tpu.parallel.jitutil import (
     bucket_size,
@@ -1223,6 +1228,15 @@ class MeshExecutor:
         # down to the mesh for device-resident chaining).
         if task.chain is None:
             return False
+        if getattr(task, "coded_group", None) is not None or any(
+            getattr(d, "coded", None) is not None for d in task.deps
+        ):
+            # Coded coverage members execute per-unit with per-unit
+            # store addressing, and their consumers read the masked
+            # k-of-n view — both are host-tier contracts
+            # (local._execute_coded / _coded_dep_factory); the SPMD
+            # wave pipeline has neither seam.
+            return False
         until = self._probation.get(_op_base(task.name.op))
         if until is not None:
             import time as _time
@@ -1487,6 +1501,13 @@ class MeshExecutor:
                 self._submit_host(t)
             return
         try:
+            # Wave-boundary cancellation seam (deadline ladder): a
+            # cancel requested before dispatch stops the whole group
+            # here; one requested mid-group stops between waves
+            # (_execute_waves) — never mid-collective, where a partial
+            # stop would wedge the gang.
+            for t in claimed:
+                t.check_cancel()
             if self._keepalive is not None:
                 # Fail fast on a wedged peer instead of entering a
                 # collective that can never complete.
@@ -1519,6 +1540,11 @@ class MeshExecutor:
                     out.gather()
             for t in claimed:
                 t.mark_ok()
+        except TaskCancelled:
+            # Cooperative stop (deadline expiry): the group's claimed
+            # members settle CANCELLED — resubmittable, not fatal.
+            for t in claimed:
+                t.transition_if(TaskState.RUNNING, TaskState.CANCELLED)
         except DepLost as e:
             for p in e.producers:
                 p.mark_lost(e)
@@ -2224,6 +2250,11 @@ class MeshExecutor:
         if depth == 0:
             outs: List[DeviceGroupOutput] = []
             for w in range(len(wave_tasks)):
+                if w:
+                    # Between-waves cancellation seam (deadline
+                    # ladder); every group member shares the request,
+                    # so one representative read suffices.
+                    wave_tasks[w][0].check_cancel()
                 ow = self._execute_wave(
                     wave_tasks[w], wave=w,
                     inputs=inputs0 if w == 0 else None,
@@ -2327,6 +2358,10 @@ class MeshExecutor:
 
         try:
             for w in range(nwaves):
+                if w:
+                    # Between-waves cancellation seam (deadline
+                    # ladder) — same contract as the serial loop's.
+                    wave_tasks[w][0].check_cancel()
                 if w == 0:
                     inputs = inputs0
                 else:

@@ -411,6 +411,22 @@ class Session:
                 self.telemetry.kernel_select = selector.stats
             if hasattr(executor, "kernel_select"):
                 executor.kernel_select = selector
+        # Coded k-of-n redundant combines (exec/codedplan.py):
+        # BIGSLICE_CODED engages proactive straggler tolerance — combine
+        # boundaries over-decompose into striped coverage groups, the
+        # consumer wave fires at any covering k-subset, stragglers are
+        # cooperatively cancelled. Same chicken-bit contract: unset =
+        # planner_from_env returns None and NOTHING here attaches —
+        # byte-identical task graphs and zero bigslice_coded_* samples.
+        self.coded = None
+        from bigslice_tpu.exec import codedplan as codedplan_mod
+
+        coded = codedplan_mod.planner_from_env(self.telemetry)
+        if coded is not None:
+            self.coded = coded
+            if self.telemetry is not None:
+                self.telemetry.coded = coded.stats
+            executor.coded = coded
         executor.start(self)
         # Rank-stamp the start event on multi-process gangs so
         # slicetrace's N-file merge (--merge) can assign each per-rank
@@ -437,13 +453,21 @@ class Session:
         if state == TaskState.OK:
             self.eventer("bigslice:taskComplete", task=str(task.name))
 
-    def run(self, func: Any, *args, corr: Optional[str] = None
-            ) -> Result:
+    def run(self, func: Any, *args, corr: Optional[str] = None,
+            deadline_s: Optional[float] = None) -> Result:
         """Compile and evaluate ``func(*args)`` (exec/session.go:214-225).
 
         ``func`` may be a registered ``Func``, a plain slice-returning
         callable, or a ``Slice`` directly (test convenience, mirroring
         slicetest.Run).
+
+        ``deadline_s`` bounds THIS invocation's evaluation wall time:
+        when it expires, in-flight tasks are cooperatively cancelled
+        at their next seam (frame, coverage unit, wave boundary), the
+        executor's slots are drained, and ``DeadlineExceeded``
+        (exec/evaluate.py) propagates — the tasks stay resubmittable,
+        so a later run of the same graph picks up where this one was
+        cut off. The serving plane threads its per-request budget here.
 
         ``corr`` is the cross-rank correlation id: the serving plane
         mints one per request (deterministic across SPMD ranks — every
@@ -454,6 +478,19 @@ class Session:
         Defaults to ``inv<index>`` — itself identical across ranks by
         the shared-invocation-counter contract.
         """
+        # The deadline clock starts BEFORE slice construction and
+        # compilation: the caller's budget is for the invocation, and a
+        # pathological build or compile must not silently eat it
+        # without ever being charged.
+        deadline = None
+        if deadline_s is not None:
+            if deadline_s <= 0:
+                raise typecheck.errorf(
+                    "run: deadline_s must be > 0, got %r", deadline_s
+                )
+            import time as _time
+
+            deadline = _time.monotonic() + float(deadline_s)
         exclusive = False
         if isinstance(func, Func):
             inv = func.invocation(*args)
@@ -503,6 +540,7 @@ class Session:
             kernel_select_mode=(self.kernel_select.mode
                                 if self.kernel_select is not None
                                 else None),
+            coded=self.coded,
         ).compile(slice_)
         if self.debug is not None:
             self.debug.register_roots(tasks)
@@ -520,7 +558,8 @@ class Session:
                 xprof = self.profiler.trace_run()
                 err = None
                 try:
-                    evaluate(self.executor, tasks, monitor=self.monitor)
+                    evaluate(self.executor, tasks, monitor=self.monitor,
+                             deadline=deadline)
                 except Exception as e:  # noqa: BLE001
                     err = e
                 finally:
@@ -550,7 +589,16 @@ class Session:
                     )
                     if release is not None:
                         release(tasks)
+                    if deadline_s is not None:
+                        self._record_deadline("met", deadline_s)
                     break
+                from bigslice_tpu.exec.evaluate import DeadlineExceeded
+
+                if isinstance(err, DeadlineExceeded):
+                    # Not a loss the elastic ladder can buy back: the
+                    # caller's budget is spent. Attribute and raise.
+                    self._record_deadline("expired", deadline_s)
+                    raise err
                 if attempts >= self.elastic or not _is_gang_loss(err):
                     # Fatal for this run: dump the flight recorder's
                     # event ring beside the raise so the post-mortem
@@ -603,6 +651,19 @@ class Session:
         res = Result(self, slice_, tasks)
         res.corr = corr
         return res
+
+    def _record_deadline(self, outcome: str, deadline_s) -> None:
+        """Attribute a deadline outcome to the telemetry hub's deadline
+        stats (lazily created there — zero samples until the first
+        deadline-carrying run). Best-effort."""
+        hub = self.telemetry
+        if hub is None:
+            return
+        try:
+            hub.record_deadline(outcome, deadline_s=deadline_s,
+                                source="session")
+        except Exception:
+            pass
 
     def _mesh_signature(self):
         """The executor's repr-stable mesh-topology signature (axis

@@ -26,6 +26,12 @@ class TaskState(enum.IntEnum):
     OK = 3
     ERR = 4
     LOST = 5
+    # Cooperatively cancelled (coded coverage settled without this
+    # member, or the invocation's deadline expired). Ordered above LOST
+    # so ``wait_state(OK)`` waiters wake; unlike ERR it is not fatal —
+    # the evaluator may resubmit a CANCELLED task if it becomes needed
+    # again (coverage loss, a Result re-read after a deadline abort).
+    CANCELLED = 6
 
 
 class TaskError(Exception):
@@ -36,6 +42,18 @@ class TaskError(Exception):
         self.task = task
         self.cause = cause
         super().__init__(f"task {task.name}: {cause!r}")
+
+
+class TaskCancelled(Exception):
+    """Raised inside a task body at a cancellation seam (frame loop,
+    per-unit coverage step, wave boundary) after ``request_cancel``:
+    the executor transitions the task RUNNING→CANCELLED instead of ERR.
+    Cooperative by design — a task only stops where it can stop
+    cleanly, never mid-store-write."""
+
+    def __init__(self, task: "Task"):
+        self.task = task
+        super().__init__(f"task {task.name} cancelled")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -66,6 +84,12 @@ class TaskDep:
     partition: int
     expand: bool = False
     combine_key: str = ""
+    # Coded k-of-n redundant combine (exec/codedplan.py): when set,
+    # ``tasks`` are the n members of one CoverageGroup and the consumer
+    # reads a masked per-unit view (any covering k-subset) instead of
+    # every producer. None on every task compiled with BIGSLICE_CODED
+    # unset — the chicken-bit invariant the dataclass default encodes.
+    coded: object = None
 
 
 class Partitioner:
@@ -120,6 +144,12 @@ class Task:
         self._state = TaskState.INIT
         self.error: Optional[BaseException] = None
         self._subs: List[Callable] = []
+        # Cooperative cancellation: the flag is checked at task-body
+        # seams (frame loop, coded per-unit step, wave boundary); the
+        # event wakes blocked bodies (the chaos plane's ``~stuck``
+        # kind parks on it). Cleared on resubmission.
+        self.cancel_requested = False
+        self.cancel_event = threading.Event()
         # Evaluator bookkeeping (exec/eval.go:108-159).
         self.consecutive_lost = 0
         # Monotonic stamp of the most recent transition INTO each state
@@ -179,6 +209,28 @@ class Task:
             self._cond.wait_for(lambda: self._state >= minimum,
                                 timeout=timeout)
             return self._state
+
+    def request_cancel(self) -> None:
+        """Ask a WAITING/RUNNING task to stop at its next cancellation
+        seam. Does NOT transition state — the executor (or the
+        evaluator, for never-started tasks) performs the CANCELLED
+        transition when the body actually stops."""
+        with self._lock:
+            self.cancel_requested = True
+        self.cancel_event.set()
+
+    def clear_cancel(self) -> None:
+        """Reset the cancellation request (resubmission of a CANCELLED
+        task that became needed again)."""
+        with self._lock:
+            self.cancel_requested = False
+        self.cancel_event.clear()
+
+    def check_cancel(self) -> None:
+        """Seam helper: raise TaskCancelled if cancellation was
+        requested (one flag read — cheap enough for per-frame use)."""
+        if self.cancel_requested:
+            raise TaskCancelled(self)
 
     def mark_ok(self) -> None:
         with self._lock:

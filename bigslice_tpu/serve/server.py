@@ -49,6 +49,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from bigslice_tpu.exec.evaluate import DeadlineExceeded
 from bigslice_tpu.utils.debughttp import DebugServer
 
 # Bounded per-tenant latency samples (quantiles stay meaningful, a
@@ -276,6 +277,14 @@ class ServeServer(DebugServer):
         # path is untouched.
         self._pipe_cost: Dict[str, int] = {}
         self._cost_inflight = 0
+        # Deadline admission (PR-20 ladder): per-pipeline wall-clock
+        # EWMA, measured from completed invocations. A request with a
+        # ``deadline_s`` budget is shed 504-early at admission when
+        # the predicted wall (EWMA × (1 + its queue position)) already
+        # exceeds the remaining budget — failing in microseconds what
+        # would otherwise burn a slot and fail anyway. Empty until the
+        # first completion, so an unmeasured pipeline always admits.
+        self._pipe_latency: Dict[str, float] = {}
         # Correlation-id sequence: invocations with no caller-supplied
         # ``corr`` get ``<pipeline>:<seq>``. Deterministic across SPMD
         # ranks by the same-driver contract (every rank's server sees
@@ -405,6 +414,12 @@ class ServeServer(DebugServer):
             "queue_depth": self.queue_depth,
             "tenant_quota": self.tenant_quota,
         }
+        with self._adm:
+            if self._pipe_latency:
+                doc["admission"]["latency_ewma_s"] = {
+                    k: round(v, 6)
+                    for k, v in self._pipe_latency.items()
+                }
         if self._cost_planner() is not None:
             with self._adm:
                 doc["admission"]["cost"] = {
@@ -464,6 +479,19 @@ class ServeServer(DebugServer):
             return 400, {"error": "max_rows must be an integer"}
         if not isinstance(args, list):
             return 400, {"error": "args must be a JSON array"}
+        deadline_s = req.get("deadline_s")
+        if deadline_s is not None:
+            try:
+                deadline_s = float(deadline_s)
+            except (TypeError, ValueError):
+                return 400, {"error": "deadline_s must be a number"}
+            if deadline_s <= 0:
+                return 400, {"error": "deadline_s must be > 0"}
+        # Absolute budget, stamped before admission: queue wait, wave
+        # evaluation and row materialisation all spend from the same
+        # clock the caller started.
+        deadline = (time.monotonic() + deadline_s
+                    if deadline_s is not None else None)
         with self._pipe_lock:
             pipe = self._pipelines.get(name)
         if pipe is None:
@@ -498,6 +526,28 @@ class ServeServer(DebugServer):
                              f"{self.queue_depth} queued)",
                     "retry": True,
                 }
+            if deadline is not None:
+                # Predictive 504: shed now if this pipeline's measured
+                # wall × (1 + queue position) can't fit the budget.
+                ewma = float(self._pipe_latency.get(name) or 0.0)
+                queue_pos = (self.stats.queued
+                             if self.stats.active >= self.slots else 0)
+                predicted_wall = ewma * (1 + queue_pos)
+                remaining = deadline - time.monotonic()
+                if ewma > 0.0 and predicted_wall > remaining:
+                    self.stats.record(tenant, "deadline_exceeded")
+                    self._record_deadline("rejected", tenant,
+                                          deadline_s)
+                    return 504, {
+                        "error": f"deadline {deadline_s}s cannot be "
+                                 f"met: predicted wall "
+                                 f"{predicted_wall:.3f}s "
+                                 f"(EWMA {ewma:.3f}s × "
+                                 f"{1 + queue_pos} queue position) "
+                                 f"exceeds remaining "
+                                 f"{max(0.0, remaining):.3f}s",
+                        "retry": False,
+                    }
             if planner is not None:
                 # Cost gate: shed when this pipeline's predicted bytes-
                 # accessed would push the admitted total past the
@@ -531,7 +581,25 @@ class ServeServer(DebugServer):
             else:
                 self.stats.queued += 1
                 while self.stats.active >= self.slots:
-                    self._adm.wait()
+                    if deadline is not None:
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            # Budget burned in the queue: shed without
+                            # ever taking a slot.
+                            self.stats.queued -= 1
+                            self.stats.adjust_inflight(tenant, -1)
+                            self.stats.record(tenant,
+                                              "deadline_exceeded")
+                            self._record_deadline("expired", tenant,
+                                                  deadline_s)
+                            return 504, {
+                                "error": f"deadline {deadline_s}s "
+                                         f"expired while queued",
+                                "retry": False,
+                            }
+                        self._adm.wait(timeout=remaining)
+                    else:
+                        self._adm.wait()
                     if self._closing:
                         self.stats.queued -= 1
                         self.stats.adjust_inflight(tenant, -1)
@@ -546,9 +614,24 @@ class ServeServer(DebugServer):
         b0 = self._cost_probe() if planner is not None else 0
         try:
             doc = self._run(pipe, args, want_rows, max_rows,
-                            corr=corr)
+                            corr=corr, deadline=deadline)
             if planner is not None:
                 self._cost_measure(planner, name, b0, sole)
+        except DeadlineExceeded as e:
+            # Mid-flight expiry: the evaluator already cancelled and
+            # drained the remaining tasks; the finally below releases
+            # this slot to the next queued tenant immediately.
+            latency = time.perf_counter() - t0
+            self.stats.record(tenant, "deadline_exceeded", latency)
+            self._record_deadline("expired", tenant, deadline_s)
+            return 504, {
+                "error": str(e),
+                "pipeline": name,
+                "corr": corr,
+                "latency_s": round(latency, 6),
+                "pending_tasks": e.pending,
+                "retry": False,
+            }
         except Exception as e:  # noqa: BLE001 — serve errors as JSON
             latency = time.perf_counter() - t0
             self.stats.record(tenant, "error", latency)
@@ -565,6 +648,15 @@ class ServeServer(DebugServer):
                 self.stats.adjust_inflight(tenant, -1)
                 self._adm.notify_all()
         latency = time.perf_counter() - t0
+        with self._adm:
+            prev = self._pipe_latency.get(name)
+            # EWMA (alpha 0.3): tracks drift without letting one cold
+            # compile poison the admission predictor forever.
+            self._pipe_latency[name] = (
+                latency if prev is None else 0.7 * prev + 0.3 * latency
+            )
+        if deadline_s is not None:
+            self._record_deadline("met", tenant, deadline_s)
         self.stats.record(tenant, "ok", latency,
                           rows=doc.get("num_rows", 0))
         doc.update({
@@ -574,6 +666,20 @@ class ServeServer(DebugServer):
             "latency_s": round(latency, 6),
         })
         return 200, doc
+
+    def _record_deadline(self, outcome: str, tenant: str,
+                         deadline_s: Optional[float]) -> None:
+        """Fold one deadline outcome into the hub's DeadlineStats
+        (per-tenant, source='serve'). Best-effort: accounting never
+        fails a request."""
+        hub = getattr(self.session, "telemetry", None)
+        if hub is None:
+            return
+        try:
+            hub.record_deadline(outcome, tenant=tenant,
+                                deadline_s=deadline_s, source="serve")
+        except Exception:
+            pass
 
     def _cost_probe(self) -> int:
         """Session-total compiled bytes-accessed right now (the
@@ -612,13 +718,21 @@ class ServeServer(DebugServer):
                             f"{pipe.name}-{digest[:12]}")
 
     def _run(self, pipe: Pipeline, args, want_rows: bool,
-             max_rows: int, corr: Optional[str] = None) -> dict:
+             max_rows: int, corr: Optional[str] = None,
+             deadline: Optional[float] = None) -> dict:
         """Evaluate one invocation on the shared Session. Cached
         pipelines build their slice and run it under the ops/cache.py
         writethrough tier; plain ones go straight through
         ``Session.run`` (Func memoization and pragmas intact).
-        ``corr`` rides into the run's invocation trace instant."""
+        ``corr`` rides into the run's invocation trace instant;
+        ``deadline`` (absolute monotonic) becomes the evaluation's
+        remaining budget — whatever the queue left of it."""
         session = self.session
+        remaining = None
+        if deadline is not None:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise DeadlineExceeded(deadline_s=0.0, pending=0)
         if pipe.cache:
             from bigslice_tpu.ops.base import Slice
             from bigslice_tpu.ops.cache import Cache
@@ -631,9 +745,10 @@ class ServeServer(DebugServer):
                 )
             res = session.run(Cache(slice_,
                                     self._cache_prefix(pipe, args)),
-                              corr=corr)
+                              corr=corr, deadline_s=remaining)
         else:
-            res = session.run(pipe.fn, *args, corr=corr)
+            res = session.run(pipe.fn, *args, corr=corr,
+                              deadline_s=remaining)
 
         rows: List[list] = []
         num_rows = 0

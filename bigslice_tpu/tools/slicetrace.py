@@ -146,6 +146,7 @@ def _print_inv(out: List[str], inv, summary: dict, tasks: List[dict],
     _print_spill(out, inv, telem.get("spill", ()))
     _print_adaptive(out, inv, telem.get("adaptive", ()))
     _print_kernels(out, inv, telem.get("kernels", ()))
+    _print_coded(out, inv, telem.get("coded", ()))
     out.append("")
 
 
@@ -433,6 +434,24 @@ def _print_adaptive(out: List[str], inv, events):
                    f"{evidence}")
 
 
+def _print_coded(out: List[str], inv, events):
+    """Coded-plane lifecycle from bigslice:coded instants
+    (exec/codedplan.py): group sizing, coverage settles, straggler
+    cancellations and masked duplicate reads — absent entirely when
+    BIGSLICE_CODED is unset (the planner never attaches)."""
+    if not events:
+        return
+    out.append(f"# inv{inv}:coded (k-of-n coverage events)")
+    out.append(f"  {'action':<14} {'op':<28} detail")
+    for ev in events[-24:]:
+        a = dict(ev.get("args", {}))
+        action = str(a.pop("action", "?"))
+        op = str(a.pop("op", None) or "-")
+        a.pop("inv", None)
+        detail = " ".join(f"{k}={a[k]}" for k in sorted(a)) or "-"
+        out.append(f"  {action:<14} {op[:28]:<28} {detail}")
+
+
 def _print_kernels(out: List[str], inv, events):
     """Kernel-selector lowering decisions from bigslice:kernel_select
     instants (parallel/kernelselect.py): which kernel each combine/
@@ -473,6 +492,7 @@ def analyze(path: str) -> str:
         "bigslice:spill": "spill",
         "bigslice:adaptive": "adaptive",
         "bigslice:kernel_select": "kernels",
+        "bigslice:coded": "coded",
     }
     n_tasks = n_instants = 0
     for ev in doc.get("traceEvents", []):

@@ -109,6 +109,21 @@ _site("eval.resubmit", ("lose",),
       "evaluator _submit: the submission is lost in flight (task marked "
       "LOST; the evaluator's ladder resubmits, bounded by "
       "MAX_CONSECUTIVE_LOST)")
+_site("task.run", ("slow", "stuck", "lose"),
+      "LocalExecutor._run, after the WAITING->RUNNING claim: 'slow' = "
+      "a seeded deterministic delay before the body runs (a "
+      "reproducible slow host — the coded/speculation A/B's straggler "
+      "source, hit identically by coded and uncoded arms); 'stuck' = "
+      "the task never completes until cooperatively cancelled (blocks "
+      "on task.cancel_event -> TaskCancelled); 'lose' = the run is "
+      "lost (task marked LOST, resubmitted by the evaluator's ladder)")
+_site("coded.cover", ("lose", "slow", "stuck"),
+      "coded coverage-task per-unit step (exec/local._execute_coded; "
+      "only fires when BIGSLICE_CODED engages): 'lose' = the member is "
+      "lost mid-coverage -> LOST -> k-of-n absorbs up to r losses, "
+      "r+1 degrade to the loud recompute ladder; 'slow' = a seeded "
+      "per-unit delay; 'stuck' = the member wedges until the settled "
+      "coverage cancels it")
 
 
 def sites() -> Dict[str, dict]:
@@ -195,6 +210,47 @@ def absorb_slow(fault: Optional[Fault]) -> Optional[Fault]:
         return fault
     time.sleep(slow_delay_s(fault))
     return None
+
+
+# Upper bound on a 'stuck' fault's wedge: a stuck task that nothing
+# ever cancels must eventually fail loudly (LOST via InjectedLoss)
+# rather than hang a chicken-bit run forever — the bound is generous
+# next to any test/CI cancellation latency.
+STUCK_MAX_S = 120.0
+
+
+def absorb_slow_or_stuck(fault: Optional[Fault],
+                         task) -> Optional[Fault]:
+    """Seam helper for task-body sites with 'slow' and 'stuck' kinds:
+    'slow' sleeps its deterministic delay and is absorbed; 'stuck'
+    parks on the task's cancel_event — the fault models a task that
+    NEVER completes on its own, so the only exits are cooperative
+    cancellation (raises TaskCancelled, the executor transitions the
+    task to CANCELLED) or the loud STUCK_MAX_S timeout (raises
+    InjectedLoss -> LOST -> resubmit ladder). Other faults (or None)
+    pass through unchanged."""
+    if fault is None:
+        return None
+    if fault.kind == "slow":
+        # Cancel-aware sleep: a slowed task that coverage (or a
+        # deadline) cancels mid-delay wakes immediately instead of
+        # holding its executor slot — and its thread — for the full
+        # injected delay.
+        from bigslice_tpu.exec.task import TaskCancelled
+
+        if task.cancel_event.wait(timeout=slow_delay_s(fault)):
+            raise TaskCancelled(task)
+        return None
+    if fault.kind == "stuck":
+        from bigslice_tpu.exec.task import TaskCancelled
+
+        if task.cancel_event.wait(timeout=STUCK_MAX_S):
+            raise TaskCancelled(task)
+        raise _mark(InjectedLoss(
+            f"injected stuck task never cancelled within "
+            f"{STUCK_MAX_S:.0f}s ({fault.describe()})"
+        ), fault)
+    return fault
 
 
 def fault_site_of(e: Optional[BaseException]) -> Optional[str]:

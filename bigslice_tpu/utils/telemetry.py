@@ -152,6 +152,71 @@ class _OpRecord:
         # overlap_efficiency) — read (store/reader drain), decode
         # (codec), assemble (arena copy+pad), upload (device_put).
         self.stage_phases: Dict[str, float] = {}
+        # -- map-side combine cardinality (exec/local.py seam): rows
+        # INTO the boundary's combiner vs rows out (~distinct keys),
+        # accumulated across producer tasks. The post-combine shuffle
+        # vector alone hides true cardinality; the kernel selector's
+        # probe corpora and the coded planner's k/n sizing need it.
+        self.combine_in_rows = 0
+        self.combine_out_rows = 0
+        self.combine_boundaries = 0
+
+
+class DeadlineStats:
+    """Deadline-ladder attribution (exec/evaluate.DeadlineExceeded,
+    serve/server.py admission/expiry): outcome counts per tenant plus
+    session-level outcomes. Created lazily by the hub's first
+    ``record_deadline`` call — the zero-sample contract for
+    deadline-free processes."""
+
+    MAX_TENANTS = 64
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # (tenant, outcome) -> count; tenant "" = non-serving (session).
+        self._counts: Dict[Tuple[str, str], int] = {}
+        self._sources: Dict[str, int] = {}
+
+    def record(self, outcome: str, tenant: str = "",
+               deadline_s=None, source: str = "") -> None:
+        tenant = str(tenant or "")
+        with self._lock:
+            known = {t for t, _ in self._counts}
+            if tenant not in known and len(known) >= self.MAX_TENANTS:
+                tenant = "_overflow"
+            k = (tenant, str(outcome))
+            self._counts[k] = self._counts.get(k, 0) + 1
+            if source:
+                self._sources[source] = self._sources.get(source, 0) + 1
+
+    def count(self, outcome: str, tenant: Optional[str] = None) -> int:
+        with self._lock:
+            return sum(
+                n for (t, o), n in self._counts.items()
+                if o == outcome and (tenant is None or t == tenant)
+            )
+
+    def summary(self) -> dict:
+        with self._lock:
+            by_tenant: Dict[str, Dict[str, int]] = {}
+            for (t, o), n in sorted(self._counts.items()):
+                by_tenant.setdefault(t or "_session", {})[o] = n
+            return {
+                "by_tenant": by_tenant,
+                "by_source": dict(sorted(self._sources.items())),
+            }
+
+    def prometheus_lines(self, metric, line) -> None:
+        with self._lock:
+            counts = dict(self._counts)
+        metric("bigslice_deadline_outcomes_total",
+               "Deadline-ladder outcomes (met, expired, "
+               "rejected_admission, queue_timeout) per tenant; tenant "
+               "_session = non-serving Session.run(deadline_s=) calls.",
+               "counter")
+        for (t, o), n in sorted(counts.items()):
+            line("bigslice_deadline_outcomes_total",
+                 {"tenant": t or "_session", "outcome": o}, n)
 
 
 class TelemetryHub:
@@ -225,6 +290,17 @@ class TelemetryHub:
         # bigslice_kernel_select_* Prometheus families. None with the
         # knob unset — neither family ever emits a sample then.
         self.kernel_select = None
+        # Coded k-of-n plane (exec/codedplan.py): the Session attaches
+        # its planner's CodedStats here when BIGSLICE_CODED engages, so
+        # coverage/cancel/mask decisions ride summary()["coded"] and
+        # the bigslice_coded_* Prometheus families. None with the knob
+        # unset — neither family ever emits a sample then.
+        self.coded = None
+        # Deadline plane (exec/evaluate.py / serve/server.py): created
+        # lazily by the FIRST record_deadline call — a process that
+        # never runs with a deadline exports zero bigslice_deadline_*
+        # samples, the same zero-sample discipline as the knob planes.
+        self.deadline = None
         self.skew_ratio = skew_ratio
         self.skew_min_rows = skew_min_rows
         self.straggler_factor = straggler_factor
@@ -333,6 +409,13 @@ class TelemetryHub:
                     self._recovery_pending[key] = (
                         times.get(TaskState.LOST, now), site,
                     )
+            elif state == TaskState.CANCELLED:
+                # Cooperative cancellation (coded coverage settled /
+                # deadline expired): no duration sample — a cancelled
+                # body's wall says nothing about the op — and the task
+                # must leave the running ledger or live_stragglers
+                # would keep flagging a body that already stopped.
+                rec.running.pop(key, None)
             elif state == TaskState.ERR:
                 rec.running.pop(key, None)
                 pend = self._recovery_pending.pop(key, None)
@@ -547,6 +630,40 @@ class TelemetryHub:
         ratio = mx / max(median, 1.0)
         return ratio, max_shard, median, total
 
+    def record_combine_input(self, op: str, inv: Optional[int],
+                             in_rows: int, out_rows: int) -> None:
+        """One producer task's map-side combine cardinality: rows INTO
+        the boundary's combiner and rows out (~distinct keys for the
+        full boundary once every producer reports). The executor calls
+        this per combine-bearing task (exec/local.py); post-combine
+        shuffle sizes alone understate cardinality by exactly the
+        combine's collapse factor."""
+        in_rows = max(0, int(in_rows))
+        out_rows = max(0, int(out_rows))
+        with self._lock:
+            rec = self._op(op, inv)
+            rec.combine_in_rows += in_rows
+            rec.combine_out_rows += out_rows
+            rec.combine_boundaries += 1
+        self._emit("bigslice:combineInput", op=op, inv=inv,
+                   in_rows=in_rows, out_rows=out_rows)
+
+    def record_deadline(self, outcome: str, tenant: str = "",
+                        deadline_s=None, source: str = "") -> None:
+        """One deadline-ladder outcome (met / expired /
+        rejected_admission / queue_timeout ...), attributed per tenant.
+        The DeadlineStats holder is created lazily HERE: a process that
+        never sees a deadline keeps ``hub.deadline is None`` and emits
+        zero bigslice_deadline_* samples."""
+        with self._lock:
+            if self.deadline is None:
+                self.deadline = DeadlineStats()
+        self.deadline.record(outcome, tenant=tenant,
+                             deadline_s=deadline_s, source=source)
+        self._emit("bigslice:deadline", outcome=outcome,
+                   tenant=tenant or None, deadline_s=deadline_s,
+                   source=source or None)
+
     # The staging-breakdown phases an executor may report (the staging
     # fast path's read → decode → assemble → upload chain); unknown
     # keys are dropped so a buggy caller can't grow the record.
@@ -607,7 +724,7 @@ class TelemetryHub:
             ratio, max_shard, median, total = self._skew_of(
                 rec.part_rows
             )
-            return {
+            out = {
                 "ratio": ratio,
                 "max_shard": max_shard,
                 "median_rows": median,
@@ -616,6 +733,17 @@ class TelemetryHub:
                 "flagged": (total >= self.skew_min_rows
                             and ratio >= self.skew_ratio),
             }
+            if rec.combine_boundaries:
+                # True pre-combine cardinality at the op's map-side
+                # combine boundary (record_combine_input): input rows
+                # and the distinct-key ratio (rows out / rows in; 1.0
+                # = all-distinct, small = heavy collapse).
+                out["combine_input_rows"] = rec.combine_in_rows
+                out["distinct_key_ratio"] = (
+                    rec.combine_out_rows
+                    / max(1, rec.combine_in_rows)
+                )
+            return out
 
     def live_stragglers(self) -> List[dict]:
         """RUNNING tasks whose elapsed time already exceeds the
@@ -811,6 +939,18 @@ class TelemetryHub:
                 out["kernel_select"] = kselect.summary()
             except Exception:
                 out["kernel_select"] = {}
+        coded = self.coded
+        if coded is not None:
+            try:
+                out["coded"] = coded.summary()
+            except Exception:
+                out["coded"] = {}
+        deadline = self.deadline
+        if deadline is not None:
+            try:
+                out["deadline"] = deadline.summary()
+            except Exception:
+                out["deadline"] = {}
         return out
 
     def snapshot(self, rank: Optional[int] = None,
@@ -1211,6 +1351,22 @@ class TelemetryHub:
         if kselect is not None:
             try:
                 kselect.prometheus_lines(metric, line)
+            except Exception:
+                pass
+
+        # -- coded k-of-n plane (exec/codedplan.py) -------------------
+        coded = self.coded
+        if coded is not None:
+            try:
+                coded.prometheus_lines(metric, line)
+            except Exception:
+                pass
+
+        # -- deadline ladder (exec/evaluate.py / serve/server.py) -----
+        deadline = self.deadline
+        if deadline is not None:
+            try:
+                deadline.prometheus_lines(metric, line)
             except Exception:
                 pass
 

@@ -530,3 +530,89 @@ def test_slicetrace_renders_adaptive_section(tmp_path, monkeypatch):
     report = slicetrace.analyze(str(trace))
     assert ":adaptive" in report
     assert "skew" in report and "ratio=" in report
+
+
+# ------- speculation vs coded coverage (PR-20 satellite: atomicity)
+
+
+def test_spec_watcher_skips_coded_members(monkeypatch):
+    """The spec policy must never race a coded coverage member: its
+    redundancy is pre-paid by the stripe, and a duplicate would fight
+    the coverage-settle cancellation over the same RUNNING task."""
+    monkeypatch.setenv("BIGSLICE_ADAPTIVE", "spec")
+    monkeypatch.setenv("BIGSLICE_ADAPTIVE_POLL_S", "0.005")
+    monkeypatch.setenv("BIGSLICE_CODED", "combine")
+    monkeypatch.setenv("BIGSLICE_CHAOS_SLOW_S", "0.4")
+    faultinject.install(faultinject.parse_plan(
+        "11:coded.cover=1.0x2~slow"))
+    try:
+        sess = Session(executor=LocalExecutor(procs=4))
+        sess.telemetry.straggler_factor = 1.5
+        sess.telemetry.straggler_min_secs = 0.05
+        sess.telemetry.straggler_min_siblings = 2
+        rng = np.random.RandomState(3)
+        keys = rng.randint(0, 97, 4000).astype(np.int32)
+        res = sess.run(bs.Reduce(bs.Const(8, keys,
+                                          np.ones(4000, np.int32)),
+                                 lambda a, b: a + b))
+        assert dict(res.rows()) == _reduce_oracle(keys)
+        # Two members were slowed well past the straggler threshold,
+        # yet NO speculative duplicate ever launched against a coded
+        # member: the coded plane absorbs stragglers by coverage, not
+        # by racing copies. (Non-coded ops may still speculate.)
+        spec_targets = [d.get("task", "") for d in
+                        sess.adaptive.stats.decisions
+                        if d["policy"] == "spec"]
+        assert not any("~k" in t for t in spec_targets), spec_targets
+        assert sess.telemetry.coded.count("covered") == 1
+    finally:
+        faultinject.clear()
+
+
+def test_executor_speculate_refuses_coded_members(monkeypatch):
+    monkeypatch.setenv("BIGSLICE_CODED", "combine")
+    sess = Session(executor=LocalExecutor(procs=2))
+    rng = np.random.RandomState(5)
+    keys = rng.randint(0, 31, 800).astype(np.int32)
+    res = sess.run(bs.Reduce(bs.Const(6, keys,
+                                      np.ones(800, np.int32)),
+                             lambda a, b: a + b))
+    from bigslice_tpu.exec.task import iter_tasks
+
+    members = [t for t in iter_tasks(res.tasks)
+               if getattr(t, "coded_group", None) is not None]
+    assert members
+    ex = sess.executor
+    assert all(not ex.speculate(m) for m in members)
+
+
+def test_cancel_vs_finish_transition_is_first_wins():
+    """The RUNNING→OK vs RUNNING→CANCELLED race (coverage settling
+    while the straggler's own thread finishes) is arbitrated by the
+    task state machine's compare-and-swap: exactly one transition wins,
+    under a real thread race, every round."""
+    import threading
+
+    from bigslice_tpu.exec.task import Task, TaskName, TaskState
+
+    for _ in range(200):
+        t = Task(TaskName(0, "op", 0, 1), do=None, deps=[],
+                 partitioner=None, schema=None)
+        t.set_state(TaskState.RUNNING)
+        outcomes = []
+        bar = threading.Barrier(2)
+
+        def flip(to, outcomes=outcomes, t=t, bar=bar):
+            bar.wait()
+            outcomes.append((to, t.transition_if(TaskState.RUNNING,
+                                                 to)))
+
+        th = [threading.Thread(target=flip, args=(s,))
+              for s in (TaskState.OK, TaskState.CANCELLED)]
+        for x in th:
+            x.start()
+        for x in th:
+            x.join()
+        wins = [to for to, won in outcomes if won]
+        assert len(wins) == 1
+        assert t.state == wins[0]

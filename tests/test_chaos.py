@@ -596,3 +596,93 @@ def test_chaosslice_cli_spill(tmp_path, capsys, monkeypatch):
     assert doc["shuffle"] == "spill"
     sites = {r["site"] for r in doc["matrix"]}
     assert sites & {"spill.read", "spill.write"}, doc["matrix"]
+
+
+# -- coded k-of-n coverage under chaos (exec/codedplan.py, PR-20) ---------
+
+
+def _coded_reduce(procs=4, shards=8, seed=13):
+    rng = np.random.RandomState(seed)
+    keys = rng.randint(0, 41, 1600).astype(np.int32)
+    vals = rng.randint(1, 5, 1600).astype(np.int32)
+    sess = Session(executor=LocalExecutor(procs=procs))
+    res = sess.run(bs.Reduce(bs.Const(shards, keys, vals),
+                             lambda a, b: a + b))
+    return sess, res, _reduce_oracle2(keys, vals)
+
+
+def _reduce_oracle2(keys, vals):
+    out = {}
+    for k, v in zip(keys.tolist(), vals.tolist()):
+        out[k] = out.get(k, 0) + v
+    return out
+
+
+def test_coded_completes_with_exactly_r_losses(monkeypatch, chaos):
+    """Satellite 1a: with k=8, r=1, losing exactly r coverage members
+    (the design point) completes SILENTLY — no resubmission, no
+    recompute, the surviving k members cover every unit."""
+    monkeypatch.setenv("BIGSLICE_CODED", "combine")
+    plan = chaos("5:coded.cover=1.0x1~lose")
+    sess, res, oracle = _coded_reduce()
+    assert dict(res.rows()) == oracle
+    assert plan.snapshot()["injected"] == {"coded.cover": 1}
+    st = sess.telemetry.coded
+    assert st.count("covered") == 1
+    assert st.count("recovered") == 0  # within the r budget: no redo
+    from bigslice_tpu.exec.task import TaskState, iter_tasks
+
+    lost = [t for t in iter_tasks(res.tasks)
+            if getattr(t, "coded_group", None) is not None
+            and t.state == TaskState.LOST]
+    assert len(lost) == 1  # the lost member stays lost — nobody needs it
+
+
+def test_coded_recomputes_loudly_past_r(monkeypatch, chaos):
+    """Satellite 1b: losses beyond r break coverage; the evaluator
+    resubmits uncovered members (the LOUD path: 'recovered' events)
+    and still completes bit-identically."""
+    monkeypatch.setenv("BIGSLICE_CODED", "combine")
+    plan = chaos("5:coded.cover=1.0x12~lose")
+    sess, res, oracle = _coded_reduce()
+    assert dict(res.rows()) == oracle
+    assert plan.snapshot()["injected"] == {"coded.cover": 12}
+    st = sess.telemetry.coded
+    assert st.count("covered") >= 1
+    assert st.count("recovered") > 0  # resubmission happened, loudly
+
+
+def test_coded_stuck_member_cancelled_on_coverage(monkeypatch, chaos):
+    """Satellite 1c (~stuck kind): a member parked on its cancel
+    event is woken by the coverage cancellation and lands CANCELLED —
+    the cooperative-cancel ladder, not the 120s loud timeout."""
+    monkeypatch.setenv("BIGSLICE_CODED", "combine")
+    chaos("5:coded.cover=1.0x1~stuck")
+    t0 = time.monotonic()
+    sess, res, oracle = _coded_reduce()
+    assert dict(res.rows()) == oracle
+    assert time.monotonic() - t0 < faultinject.STUCK_MAX_S / 2
+    st = sess.telemetry.coded
+    assert st.count("covered") == 1
+    assert st.count("cancelled") >= 1
+    from bigslice_tpu.exec.task import TaskState, iter_tasks
+
+    cancelled = [t for t in iter_tasks(res.tasks)
+                 if getattr(t, "coded_group", None) is not None
+                 and t.state == TaskState.CANCELLED]
+    assert cancelled  # the parked member woke into CANCELLED
+
+
+def test_stuck_task_times_out_to_loss_without_coded(monkeypatch,
+                                                    chaos):
+    """~stuck on the generic task.run seam with the coded plane OFF:
+    nothing ever cancels, so the park must hit the loud STUCK_MAX_S
+    timeout, surface as an injected LOSS, and recover by
+    resubmission."""
+    monkeypatch.delenv("BIGSLICE_CODED", raising=False)
+    monkeypatch.setattr(faultinject, "STUCK_MAX_S", 0.3)
+    plan = chaos("5:task.run=1.0x1~stuck")
+    sess, res, oracle = _coded_reduce(procs=4)
+    assert dict(res.rows()) == oracle
+    assert plan.snapshot()["injected"] == {"task.run": 1}
+    assert sess.telemetry.coded is None  # chicken bit stayed off

@@ -640,3 +640,114 @@ def test_debug_server_close_drains():
     assert out.get("code") == 200
     sess.status.render = orig
     sess.shutdown()
+
+
+# --------------------------- deadline ladder (PR-20 satellite)
+
+
+def test_deadline_admission_predictive_504(slow_server):
+    """A request whose budget can't cover the pipeline's measured
+    wall (EWMA x queue position) sheds 504 AT ADMISSION — before it
+    burns a slot it is guaranteed to waste."""
+    srv, gate, started = slow_server
+    # Happy path: primes the EWMA, records a per-tenant 'met'.
+    code, doc = srv.invoke_request({"pipeline": "fast",
+                                    "tenant": "bob",
+                                    "deadline_s": 60})
+    assert code == 200, doc
+    assert srv._pipe_latency["fast"] > 0
+    stats = srv.serving_stats()
+    assert stats["admission"]["latency_ewma_s"]["fast"] > 0
+    # Force an unmeetable prediction; the request never executes.
+    srv._pipe_latency["fast"] = 50.0
+    code, doc = srv.invoke_request({"pipeline": "fast",
+                                    "tenant": "bob",
+                                    "deadline_s": 0.5})
+    assert code == 504
+    assert doc.get("retry") is False
+    assert "predicted wall" in doc["error"]
+    outcomes = srv.stats.summary()["tenants"]["bob"]["outcomes"]
+    assert outcomes["deadline_exceeded"] == 1
+    assert outcomes["ok"] == 1
+    hub = srv.session.telemetry
+    assert hub.deadline.count("met", "bob") == 1
+    assert hub.deadline.count("rejected", "bob") == 1
+    assert hub.deadline.summary()["by_source"]["serve"] == 2
+    # Validation: non-numeric / non-positive budgets are 400s.
+    for bad in ("soon", 0, -3):
+        code, doc = srv.invoke_request({"pipeline": "fast",
+                                        "deadline_s": bad})
+        assert code == 400, (bad, doc)
+
+
+def test_deadline_expires_in_queue_504(slow_server):
+    """A queued request whose budget burns out waiting sheds 504
+    without ever taking the slot."""
+    srv, gate, started = slow_server
+    srv.queue_depth = 4
+    out = {}
+
+    def occupy():
+        out["first"] = srv.invoke_request({"pipeline": "slow"})
+
+    t = threading.Thread(target=occupy)
+    t.start()
+    assert started.wait(10)
+    t0 = time.monotonic()
+    code, doc = srv.invoke_request({"pipeline": "fast",
+                                    "tenant": "carol",
+                                    "deadline_s": 0.3})
+    waited = time.monotonic() - t0
+    assert code == 504
+    assert "expired while queued" in doc["error"]
+    assert 0.2 < waited < 10.0
+    gate.set()
+    t.join(30)
+    assert out["first"][0] == 200
+    outcomes = srv.stats.summary()["tenants"]["carol"]["outcomes"]
+    assert outcomes["deadline_exceeded"] == 1
+    assert srv.session.telemetry.deadline.count("expired",
+                                                "carol") == 1
+
+
+def test_deadline_midflight_504_frees_slot_for_queued(slow_server):
+    """Mid-flight expiry: the evaluator cancels + drains, the 504
+    releases the slot, and the QUEUED tenant (no deadline) runs to
+    200 on it — the end-to-end cancellation ladder."""
+    srv, gate, started = slow_server
+    srv.queue_depth = 4
+    out = {}
+
+    def first():
+        out["a"] = srv.invoke_request({"pipeline": "slow",
+                                       "tenant": "alice",
+                                       "deadline_s": 0.25})
+
+    def second():
+        out["b"] = srv.invoke_request({"pipeline": "fast",
+                                       "tenant": "dave"})
+
+    ta = threading.Thread(target=first)
+    ta.start()
+    assert started.wait(10)
+    tb = threading.Thread(target=second)
+    tb.start()
+    time.sleep(0.5)  # alice's budget burns while the gate is held
+    gate.set()
+    ta.join(30)
+    tb.join(30)
+    code_a, doc_a = out["a"]
+    assert code_a == 504, doc_a
+    assert "pending_tasks" in doc_a
+    code_b, doc_b = out["b"]
+    assert code_b == 200, doc_b
+    outcomes = srv.stats.summary()["tenants"]["alice"]["outcomes"]
+    assert outcomes["deadline_exceeded"] == 1
+    hub = srv.session.telemetry
+    assert hub.deadline.count("expired", "alice") == 1
+    doc = hub.summary()["deadline"]
+    assert doc["by_tenant"]["alice"]["expired"] == 1
+    assert doc["by_source"]["serve"] >= 1
+    text = hub.prometheus_text()
+    assert ('bigslice_deadline_outcomes_total{tenant="alice",'
+            'outcome="expired"} 1') in text
